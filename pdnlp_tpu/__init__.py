@@ -1,0 +1,21 @@
+"""pdnlp_tpu — a TPU-native (JAX/XLA/pjit/Pallas) distributed-NLP training
+framework with the capabilities of ``mosscc/pytorch-distributed-NLP``.
+
+The reference is a matrix of ~10 CUDA/torch training strategies for a Chinese
+BERT emotion classifier (see ``/root/reference/README.md:10-20``).  This
+package re-designs that capability matrix TPU-first:
+
+- NCCL collectives            -> XLA collectives over the ICI mesh
+  (``jax.lax.psum`` / ``all_gather``), see :mod:`pdnlp_tpu.parallel`.
+- ``DistributedSampler``      -> per-host shards of a seeded global
+  permutation, see :mod:`pdnlp_tpu.data.sampler`.
+- ``torch.cuda.amp``          -> XLA bfloat16 compute policy
+  (:mod:`pdnlp_tpu.train.precision`) — no loss scaling needed on TPU.
+- DeepSpeed ZeRO-3            -> parameter/grad/optimizer-state sharding
+  along the data axis via ``NamedSharding`` (:mod:`pdnlp_tpu.parallel.sharding`).
+- HF ``BertForSequenceClassification`` -> an in-repo flax BERT
+  (:mod:`pdnlp_tpu.models.bert`) with Pallas attention kernels
+  (:mod:`pdnlp_tpu.ops`).
+"""
+
+__version__ = "0.1.0"

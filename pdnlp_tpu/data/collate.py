@@ -1,0 +1,52 @@
+"""Batch collation: (text, label) pairs -> fixed-shape numpy batches.
+
+Mirrors ``Collate.collate_fn`` (``single-gpu-cls.py:44-84``) but returns
+numpy (host) arrays sized for static XLA shapes.  Two TPU-specific additions:
+
+- an ``example_weight`` channel so padded filler rows (needed to keep the
+  last batch full — XLA wants static shapes, unlike the reference's ragged
+  288th step of 16 examples, ``SURVEY.md`` §7 hard-part (c)) contribute zero
+  loss and are excluded from metrics;
+- int32 instead of int64 (TPUs have no fast int64 path).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from pdnlp_tpu.data.tokenizer import WordPieceTokenizer
+
+Batch = Dict[str, np.ndarray]
+
+
+class Collator:
+    def __init__(self, tokenizer: WordPieceTokenizer, max_seq_len: int = 128):
+        self.tokenizer = tokenizer
+        self.max_seq_len = max_seq_len
+
+    def __call__(self, examples: Sequence[Tuple[str, int]], pad_to: int = 0) -> Batch:
+        """Encode a list of examples; pad the batch up to ``pad_to`` rows."""
+        texts = [t for t, _ in examples]
+        labels = [l for _, l in examples]
+        enc = self.tokenizer.encode_batch(texts, self.max_seq_len)
+        n = len(examples)
+        rows = max(pad_to, n)
+        batch: Batch = {
+            k: _pad_rows(v, rows) for k, v in enc.items()
+        }
+        lab = np.zeros((rows,), dtype=np.int32)
+        lab[:n] = labels
+        w = np.zeros((rows,), dtype=np.float32)
+        w[:n] = 1.0
+        batch["label"] = lab
+        batch["example_weight"] = w
+        return batch
+
+
+def _pad_rows(a: np.ndarray, rows: int) -> np.ndarray:
+    if a.shape[0] == rows:
+        return a
+    out = np.zeros((rows,) + a.shape[1:], dtype=a.dtype)
+    out[: a.shape[0]] = a
+    return out

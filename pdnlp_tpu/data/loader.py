@@ -1,0 +1,78 @@
+"""Batched data loader with background tokenization prefetch.
+
+The reference pushes tokenization into ``DataLoader(num_workers=2)``
+subprocesses (``multi-gpu-distributed-cls.py:318``).  Python
+multiprocessing buys little here (this image has one core and the tokenizer
+releases no GIL in its Python fallback), so the loader instead overlaps
+collation with device compute via a single background thread and a bounded
+queue — with the C++ tokenizer (``csrc/wordpiece.cpp``) doing the heavy
+lifting outside the GIL when built.
+
+Every batch is padded to a full static shape; short final batches carry
+``example_weight == 0`` filler rows (see ``data.collate``).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from pdnlp_tpu.data.collate import Batch, Collator
+from pdnlp_tpu.data.sampler import DistributedShardSampler
+
+
+class DataLoader:
+    def __init__(
+        self,
+        data: Sequence[Tuple[str, int]],
+        collator: Collator,
+        batch_size: int,
+        sampler: Optional[DistributedShardSampler] = None,
+        drop_last: bool = False,
+        prefetch: int = 2,
+    ):
+        self.data = data
+        self.collator = collator
+        self.batch_size = batch_size
+        self.sampler = sampler or DistributedShardSampler(len(data), shuffle=False)
+        self.drop_last = drop_last
+        self.prefetch = prefetch
+
+    def __len__(self) -> int:
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.sampler.set_epoch(epoch)
+
+    def _batches(self) -> Iterator[List[Tuple[str, int]]]:
+        idx = list(self.sampler)
+        for i in range(0, len(idx), self.batch_size):
+            chunk = idx[i : i + self.batch_size]
+            if self.drop_last and len(chunk) < self.batch_size:
+                return
+            yield [self.data[j] for j in chunk]
+
+    def __iter__(self) -> Iterator[Batch]:
+        if self.prefetch <= 0:
+            for ex in self._batches():
+                yield self.collator(ex, pad_to=self.batch_size)
+            return
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        _SENTINEL = object()
+
+        def worker():
+            try:
+                for ex in self._batches():
+                    q.put(self.collator(ex, pad_to=self.batch_size))
+            finally:
+                q.put(_SENTINEL)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                break
+            yield item
+        t.join()

@@ -1,0 +1,69 @@
+"""Deterministic distributed sharding of the dataset — the
+``DistributedSampler`` analog.
+
+The reference relies on ``torch.utils.data.DistributedSampler`` to give each
+rank a disjoint 1/world_size slice of an epoch-seeded permutation, padding so
+every rank sees the same number of steps
+(``multi-gpu-distributed-cls.py:314-330``; ``set_epoch`` at ``:164``).
+
+On TPU the "rank" is the host process: each host materializes only its shard
+of the global batch and the arrays are assembled into one global-sharded
+``jax.Array`` (see ``parallel.collectives.make_global_batch``).  Indices pad
+by wrapping, like the reference's sampler, so step counts match (144 steps at
+2-way DP for the 9,200-example epoch, ``SURVEY.md`` §6).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+
+class DistributedShardSampler:
+    def __init__(
+        self,
+        num_examples: int,
+        num_shards: int = 1,
+        shard_id: int = 0,
+        shuffle: bool = True,
+        seed: int = 123,
+        drop_last: bool = False,
+    ):
+        assert 0 <= shard_id < num_shards
+        self.num_examples = num_examples
+        self.num_shards = num_shards
+        self.shard_id = shard_id
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last:
+            self.shard_len = num_examples // num_shards
+        else:
+            self.shard_len = -(-num_examples // num_shards)  # ceil
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle differently each epoch (DistributedSampler.set_epoch analog)."""
+        self.epoch = epoch
+
+    def global_order(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            return rng.permutation(self.num_examples)
+        return np.arange(self.num_examples)
+
+    def shard_indices(self) -> np.ndarray:
+        """This shard's indices: strided slice of the (padded) global order."""
+        order = self.global_order()
+        total = self.shard_len * self.num_shards
+        if total > len(order):  # pad by wrapping, like DistributedSampler
+            order = np.concatenate([order, order[: total - len(order)]])
+        else:
+            order = order[:total]
+        return order[self.shard_id :: self.num_shards]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.shard_indices().tolist())
+
+    def __len__(self) -> int:
+        return self.shard_len

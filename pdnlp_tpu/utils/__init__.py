@@ -1,0 +1,3 @@
+from pdnlp_tpu.utils.config import Args
+from pdnlp_tpu.utils.seeding import set_seed
+from pdnlp_tpu.utils.logging import get_logger, rank0_print

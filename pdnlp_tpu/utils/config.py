@@ -1,0 +1,103 @@
+"""Single typed hyperparameter config.
+
+The reference duplicates a plain ``Args`` class nine times with drift
+(``eval_step`` 100 vs 50: ``single-gpu-cls.py:204`` vs
+``multi-gpu-distributed-cls.py:252``; model path ``hfl/...`` vs local
+``model_hub/...``: ``multi-gpu-horovod-cls.py:253``).  Here there is ONE
+dataclass; strategy entrypoints override fields instead of copy-pasting.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+
+_DEFAULT_DATA = "/root/reference/data/train.json"
+
+
+@dataclasses.dataclass
+class Args:
+    """Hyperparameters (defaults mirror ``multi-gpu-distributed-cls.py:242-257``)."""
+
+    # --- data ---
+    data_path: str = _DEFAULT_DATA
+    vocab_path: str = "output/vocab.txt"          # built from the corpus (no egress)
+    max_seq_len: int = 128                        # single-gpu-cls.py:196
+    data_limit: int = 10_000                      # first-N slice, single-gpu-cls.py:226
+    ratio: float = 0.92                           # train/dev split, single-gpu-cls.py:195
+    train_batch_size: int = 32                    # per device
+    dev_batch_size: int = 32
+
+    # --- model ---
+    model: str = "bert-base"                      # key into models.config registry
+    num_labels: int = 6
+    dropout: float = 0.1
+
+    # --- optimization (single-gpu-cls.py:86-97,193-205) ---
+    learning_rate: float = 3e-5
+    weight_decay: float = 0.01
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    adam_eps: float = 1e-6
+    epochs: int = 1
+    seed: int = 123
+
+    # --- eval / checkpoint ---
+    eval_step: int = 50                           # multi-gpu-distributed-cls.py:252
+    dev: bool = False                             # eval during training (default off)
+    output_dir: str = "output"
+    ckpt_name: str = "model.msgpack"
+
+    # --- TPU-native knobs (replace AMP / ZeRO / launcher flags) ---
+    dtype: str = "float32"                        # "bfloat16" = the AMP analog
+    strategy: str = "single"                      # single|pmap|dp|shardmap|zero|...
+    remat: bool = False                           # activation checkpointing (ZeRO analog)
+    attention_impl: str = "auto"                  # auto|xla|pallas
+    num_devices: Optional[int] = None             # cap mesh size (None = all)
+    mesh_shape: Optional[dict] = None             # e.g. {"dp": 2, "tp": 2, "sp": 2}
+    prefetch: int = 2                             # host->device pipeline depth
+    log_every: int = 1
+    profile_dir: Optional[str] = None             # jax.profiler trace output
+
+    # --- multi-host runtime (NCCL/TCPStore rendezvous analog) ---
+    coordinator_address: Optional[str] = None     # e.g. "localhost:12345"
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+    def replace(self, **kw) -> "Args":
+        return dataclasses.replace(self, **kw)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, ensure_ascii=False)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Args":
+        d = json.loads(s)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def ckpt_path(self, name: Optional[str] = None) -> str:
+        return os.path.join(self.output_dir, name or self.ckpt_name)
+
+
+def parse_cli(argv=None, base: Optional[Args] = None) -> Args:
+    """``--key value`` CLI overrides onto an ``Args`` (argparse analog of
+    ``multi-gpu-distributed-cls.py:374-381``)."""
+    import argparse
+
+    base = base or Args()
+    p = argparse.ArgumentParser()
+    for f in dataclasses.fields(Args):
+        default = getattr(base, f.name)
+        if f.type == "bool" or isinstance(default, bool):
+            p.add_argument(f"--{f.name}", type=lambda s: s.lower() in ("1", "true", "yes"),
+                           default=default)
+        elif f.name == "mesh_shape":
+            p.add_argument("--mesh_shape", type=json.loads, default=default)
+        else:
+            typ = type(default) if default is not None else str
+            p.add_argument(f"--{f.name}", type=typ, default=default)
+    ns = p.parse_args(argv)
+    return Args(**vars(ns))

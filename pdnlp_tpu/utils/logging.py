@@ -1,0 +1,52 @@
+"""Rank-0 logging with the reference's message formats.
+
+The reference logs with bare ``print`` guarded by ``local_rank == 0``
+(``multi-gpu-distributed-cls.py:178-191``) in the formats
+``【train】 epoch：1/1 step：10/288 loss：1.79`` and
+``【dev】 loss：... accuracy：...`` / ``【best accuracy】``, plus the epoch
+wall-clock line ``耗时：X分钟`` (``:193-195``).  Keeping the formats
+byte-compatible makes loss traces comparable against the README's golden
+logs (``README.md:96-100``).
+"""
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+
+def is_rank0() -> bool:
+    return jax.process_index() == 0
+
+
+def rank0_print(*args, **kw) -> None:
+    if is_rank0():
+        print(*args, **kw)
+        sys.stdout.flush()
+
+
+def get_logger(name: str = "pdnlp_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stdout)
+        h.setFormatter(logging.Formatter("[%(asctime)s %(levelname)s %(name)s] %(message)s"))
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO if is_rank0() else logging.WARNING)
+    return logger
+
+
+def fmt_train(epoch, epochs, step, total_step, loss) -> str:
+    return f"【train】 epoch：{epoch}/{epochs} step：{step}/{total_step} loss：{loss:.6f}"
+
+
+def fmt_dev(loss, accuracy) -> str:
+    return f"【dev】 loss：{loss:.6f} accuracy：{accuracy:.4f}"
+
+
+def fmt_best(accuracy) -> str:
+    return f"【best accuracy】 {accuracy:.4f}"
+
+
+def fmt_elapsed_minutes(minutes: float) -> str:
+    return f"耗时：{minutes}分钟"

@@ -1,0 +1,30 @@
+"""Determinism utilities.
+
+The reference seeds ``random``/``numpy``/``torch``/``torch.cuda`` with 123 on
+every rank (``single-gpu-cls.py:14-23``) so all ranks compute the same
+shuffle/split.  On TPU the split stays host-side (``random``/``numpy``) and
+device-side randomness flows through explicit ``jax.random`` keys — there is
+no global device RNG to seed.
+"""
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+
+
+def set_seed(seed: int = 123) -> jax.Array:
+    """Seed host RNGs and return the root JAX PRNG key.
+
+    Mirrors ``set_seed`` (``single-gpu-cls.py:14-23``); the returned key
+    replaces the implicit ``torch.manual_seed`` device stream.
+    """
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.key(seed)
+
+
+def fold(key: jax.Array, step) -> jax.Array:
+    """Derive a per-step key (e.g. for dropout) — jit-safe."""
+    return jax.random.fold_in(key, step)
