@@ -60,19 +60,41 @@ class DataLoader:
             return
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         _SENTINEL = object()
+        stop = threading.Event()
 
         def worker():
             try:
                 for ex in self._batches():
-                    q.put(self.collator(ex, pad_to=self.batch_size))
-            finally:
+                    batch = self.collator(ex, pad_to=self.batch_size)
+                    # Bounded put that notices consumer abandonment, so an
+                    # early `break` in the consumer can't strand us forever.
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
                 q.put(_SENTINEL)
+            except BaseException as e:  # propagate to the consumer, not /dev/null
+                q.put(e)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _SENTINEL:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            while t.is_alive():  # drain so any blocked put wakes up
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)

@@ -87,17 +87,27 @@ def parse_cli(argv=None, base: Optional[Args] = None) -> Args:
     ``multi-gpu-distributed-cls.py:374-381``)."""
     import argparse
 
+    import types
+    import typing
+
     base = base or Args()
     p = argparse.ArgumentParser()
+    hints = typing.get_type_hints(Args)
     for f in dataclasses.fields(Args):
         default = getattr(base, f.name)
-        if f.type == "bool" or isinstance(default, bool):
+        hint = hints.get(f.name, str)
+        # Unwrap Optional[T] so `--num_processes 4` parses as int, not "4".
+        if typing.get_origin(hint) in (typing.Union, types.UnionType):
+            inner = [a for a in typing.get_args(hint) if a is not type(None)]
+            hint = inner[0] if len(inner) == 1 else str
+        if hint is bool:
             p.add_argument(f"--{f.name}", type=lambda s: s.lower() in ("1", "true", "yes"),
                            default=default)
-        elif f.name == "mesh_shape":
-            p.add_argument("--mesh_shape", type=json.loads, default=default)
+        elif hint in (int, float, str):
+            p.add_argument(f"--{f.name}", type=hint, default=default)
         else:
-            typ = type(default) if default is not None else str
-            p.add_argument(f"--{f.name}", type=typ, default=default)
+            # dicts/lists and any future structured field parse as JSON —
+            # loud failure on malformed input beats silent str-typing.
+            p.add_argument(f"--{f.name}", type=json.loads, default=default)
     ns = p.parse_args(argv)
     return Args(**vars(ns))

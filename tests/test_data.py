@@ -74,11 +74,50 @@ def test_encode_truncation(tok):
 
 
 def test_oov_latin_decomposes(tok):
-    # a word unseen in the corpus should split into ## pieces, not one [UNK]
-    pieces = tok.tokenize("zqxjk")
-    assert len(pieces) >= 1  # must produce something deterministic
-    again = tok.tokenize("zqxjk")
-    assert pieces == again
+    # A latin word unseen as a whole token must split into continuation
+    # pieces whose characters are in the vocab — not collapse to [UNK].
+    word = "ok" * 8  # 'okokokok...' — certainly not a whole corpus token
+    pieces = tok.tokenize(word)
+    assert "[UNK]" not in pieces
+    assert len(pieces) > 1
+    assert all(p.lstrip("#") and (i == 0) == (not p.startswith("##"))
+               for i, p in enumerate(pieces))
+    assert tok.tokenize(word) == pieces  # deterministic
+
+
+def test_vocab_coverage_on_corpus(data, tok):
+    """The corpus-built vocab must cover the corpus itself: the OOV ([UNK])
+    rate over a real slice must be tiny, else accuracy parity is hopeless."""
+    total = unk = 0
+    for text, _ in data[:500]:
+        pieces = tok.tokenize(text)
+        total += len(pieces)
+        unk += sum(1 for p in pieces if p == "[UNK]")
+    assert total > 0
+    assert unk / total < 0.01, f"OOV rate {unk/total:.3%} too high"
+
+
+def test_loader_propagates_collator_error(data, tok):
+    class Boom(Collator):
+        def __call__(self, examples, pad_to=0):
+            raise RuntimeError("collate failed")
+
+    loader = DataLoader(data[:64], Boom(tok, 16), batch_size=32, prefetch=2)
+    with pytest.raises(RuntimeError, match="collate failed"):
+        list(loader)
+
+
+def test_loader_early_break_joins_worker(data, tok):
+    import threading
+
+    col = Collator(tok, max_seq_len=16)
+    loader = DataLoader(data[:300], col, batch_size=16, prefetch=1)
+    before = threading.active_count()
+    for _ in range(3):
+        it = iter(loader)
+        next(it)
+        it.close()  # early abandonment — generator finally must join worker
+    assert threading.active_count() <= before
 
 
 def test_collator_batch_shapes(tok):
