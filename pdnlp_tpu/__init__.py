@@ -13,9 +13,9 @@ package re-designs that capability matrix TPU-first:
   (:mod:`pdnlp_tpu.train.precision`) — no loss scaling needed on TPU.
 - DeepSpeed ZeRO-3            -> parameter/grad/optimizer-state sharding
   along the data axis via ``NamedSharding`` (:mod:`pdnlp_tpu.parallel.sharding`).
-- HF ``BertForSequenceClassification`` -> an in-repo flax BERT
-  (:mod:`pdnlp_tpu.models.bert`) with Pallas attention kernels
-  (:mod:`pdnlp_tpu.ops`).
+- HF ``BertForSequenceClassification`` -> an in-repo pure-functional JAX
+  BERT (:mod:`pdnlp_tpu.models.bert`: pytree params, ``lax.scan`` over
+  stacked layers) with the attention op in :mod:`pdnlp_tpu.ops`.
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""Model zoo: functional BERT encoder + classification head.
+
+``get_config(name)`` resolves an architecture; ``bert.init_params`` /
+``bert.classify`` are the init/apply pair every trainer and entrypoint uses.
+"""
+from pdnlp_tpu.models.config import BertConfig, available_models, get_config
+from pdnlp_tpu.models import bert
+
+__all__ = ["BertConfig", "available_models", "get_config", "bert"]

@@ -1,0 +1,65 @@
+"""Model configuration registry.
+
+The reference builds its model as HF ``BertConfig`` +
+``BertForSequenceClassification.from_pretrained`` with ``num_labels=6``
+(``/root/reference/single-gpu-cls.py:252-255``).  Here the architecture is a
+first-class config: one frozen dataclass, a named registry (``bert-base``
+matches ``chinese-bert-wwm-ext``'s shape: 12L/768H/12 heads, vocab 21128),
+plus small variants used by tests and the multichip dryrun.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 21_128
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    attn_dropout: float = 0.1
+    num_labels: int = 6
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_heads == 0
+        return self.hidden_size // self.num_heads
+
+    def replace(self, **kw) -> "BertConfig":
+        return dataclasses.replace(self, **kw)
+
+
+_REGISTRY = {
+    # chinese-bert-wwm-ext shape (BERT-base, ~102M params at vocab 21128)
+    "bert-base": BertConfig(),
+    # scaled-down variants for CI / virtual-mesh dryruns
+    "bert-small": BertConfig(hidden_size=512, num_layers=4, num_heads=8,
+                             intermediate_size=2048),
+    "bert-tiny": BertConfig(hidden_size=128, num_layers=2, num_heads=2,
+                            intermediate_size=512, max_position=128),
+}
+
+
+def get_config(name: str, vocab_size: Optional[int] = None,
+               num_labels: Optional[int] = None, **overrides) -> BertConfig:
+    """Look up a registered architecture, overriding data-dependent fields
+    (vocab size comes from the corpus-built vocab at runtime)."""
+    cfg = _REGISTRY[name]
+    kw = dict(overrides)
+    if vocab_size is not None:
+        kw["vocab_size"] = vocab_size
+    if num_labels is not None:
+        kw["num_labels"] = num_labels
+    return cfg.replace(**kw) if kw else cfg
+
+
+def available_models():
+    return sorted(_REGISTRY)

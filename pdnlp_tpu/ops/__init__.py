@@ -1,0 +1,4 @@
+"""TPU compute ops: attention (XLA reference path + optional Pallas flash)."""
+from pdnlp_tpu.ops.attention import dot_product_attention, mask_bias
+
+__all__ = ["dot_product_attention", "mask_bias"]

@@ -1,0 +1,63 @@
+"""Multi-head scaled-dot-product attention.
+
+The compute layout is TPU-first: batched einsums that XLA tiles straight
+onto the MXU, softmax in fp32 regardless of the compute dtype (bf16 exponent
+range is fine but the reduction wants fp32 mantissa), and an additive mask
+bias instead of boolean select so the whole score pipeline stays fused.
+
+``impl="pallas"`` selects the hand-written flash-attention kernel in
+``pdnlp_tpu.ops.flash`` when available; ``"xla"`` is the always-correct
+reference path (at seq len 128 XLA's fusion is already near-roofline, the
+pallas kernel matters for the long-context path).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # additive mask bias; well inside bf16/f32 range
+
+
+def mask_bias(attention_mask: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """[B, S] {0,1} mask -> [B, 1, 1, S] additive bias (0 keep / -1e9 drop)."""
+    return ((1.0 - attention_mask.astype(jnp.float32)) * NEG_INF).astype(dtype)[
+        :, None, None, :
+    ]
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, S, N, D]
+    k: jax.Array,  # [B, S, N, D]
+    v: jax.Array,  # [B, S, N, D]
+    bias: Optional[jax.Array] = None,  # broadcastable to [B, N, Sq, Sk]
+    impl: str = "xla",
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Returns [B, S, N, D] attention output in q's dtype.
+
+    ``dropout_rate`` > 0 (training only) drops attention *probabilities*,
+    matching HF BERT's ``attention_probs_dropout_prob``.  The pallas kernel
+    does not implement probability dropout, so a training-time dropout
+    request always takes the XLA path.
+    """
+    use_dropout = dropout_rate > 0.0 and dropout_rng is not None
+    if impl == "pallas" and not use_dropout:
+        try:
+            from pdnlp_tpu.ops import flash
+        except ImportError:
+            flash = None
+        if flash is not None and flash.supported(q):
+            return flash.flash_attention(q, k, v, bias)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqnd,bknd->bnqk", q, k) * scale
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if use_dropout:
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
+        probs = jnp.where(mask, probs / keep, 0.0).astype(probs.dtype)
+    return jnp.einsum("bnqk,bknd->bqnd", probs, v)

@@ -1,0 +1,14 @@
+"""Training layer: optimizer, precision policy, jitted steps, Trainer,
+checkpointing, and the shared experiment setup used by every entrypoint."""
+from pdnlp_tpu.train.optim import build_optimizer, decay_mask
+from pdnlp_tpu.train.precision import resolve_dtype
+from pdnlp_tpu.train.setup import setup_data, setup_model
+from pdnlp_tpu.train.steps import init_state, make_eval_step, make_train_step, weighted_ce
+from pdnlp_tpu.train.trainer import Trainer
+from pdnlp_tpu.train import checkpoint
+
+__all__ = [
+    "build_optimizer", "decay_mask", "resolve_dtype", "setup_data",
+    "setup_model", "init_state", "make_eval_step", "make_train_step",
+    "weighted_ce", "Trainer", "checkpoint",
+]

@@ -1,0 +1,62 @@
+"""Optimizer construction — AdamW with the reference's two weight-decay groups.
+
+The reference builds HF AdamW over two param groups: decay 0.01 for most
+weights, 0.0 for anything named ``bias`` or ``LayerNorm.weight``
+(``/root/reference/single-gpu-cls.py:86-97``).  The TPU-native equivalent is
+a single ``optax.adamw`` with a decay *mask* over the param pytree — same
+math, one fused update, no group bookkeeping.
+
+Our pytree's no-decay leaves are every ``bias`` and every LayerNorm
+``scale``/``bias`` (named ``*_ln`` / ``ln``), matching the reference's
+``['bias', 'LayerNorm.weight']`` filter.
+"""
+from __future__ import annotations
+
+import jax
+import optax
+
+
+def decay_mask(params) -> object:
+    """True = apply weight decay.  LayerNorm params and biases are exempt."""
+
+    def walk(tree, in_ln=False):
+        if isinstance(tree, dict):
+            return {
+                k: walk(v, in_ln or k == "ln" or k.endswith("_ln"))
+                for k, v in tree.items()
+            }
+        return not in_ln
+
+    masked = walk(params)
+
+    # biases inside dense blocks: {'kernel': ..., 'bias': ...}
+    def debias(tree, mask):
+        if isinstance(tree, dict):
+            return {
+                k: (False if k == "bias" else debias(tree[k], mask[k]))
+                for k in tree
+            }
+        return mask
+
+    return debias(params, masked)
+
+
+def build_optimizer(params, args) -> optax.GradientTransformation:
+    """AdamW lr/b1/b2/eps/wd from ``Args`` (defaults mirror
+    ``single-gpu-cls.py:86-97``: lr 3e-5, decay 0.01, no schedule)."""
+    return optax.adamw(
+        learning_rate=args.learning_rate,
+        b1=args.adam_b1,
+        b2=args.adam_b2,
+        eps=args.adam_eps,
+        weight_decay=args.weight_decay,
+        mask=decay_mask(params),
+    )
+
+
+def count_decayed(params) -> tuple:
+    """(decayed, exempt) leaf counts — used by tests and logging."""
+    mask = decay_mask(params)
+    leaves = jax.tree_util.tree_leaves(mask)
+    dec = sum(1 for m in leaves if m)
+    return dec, len(leaves) - dec

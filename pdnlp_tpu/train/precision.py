@@ -1,0 +1,30 @@
+"""Mixed-precision policy — the TPU answer to ``torch.cuda.amp``.
+
+The reference's AMP variant wraps forward/backward in ``autocast`` with a
+dynamic ``GradScaler`` (``/root/reference/multi-gpu-distributed-mp-amp-cls.py:
+160-175``).  On TPU the equivalent is simply computing in bfloat16: bf16 has
+fp32's exponent range, so there is nothing to underflow and **no loss scaler
+is needed** — master params stay fp32, matmuls/activations run bf16 on the
+MXU, softmax/LayerNorm reduce fp32, logits and the loss come back fp32.
+``--dtype bfloat16`` is therefore the whole AMP feature.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "f32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float16": jnp.float16,  # accepted for parity; bf16 is the TPU choice
+}
+
+
+def resolve_dtype(name) -> jnp.dtype:
+    if not isinstance(name, str):
+        return name
+    try:
+        return _DTYPES[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown dtype {name!r}; use one of {sorted(_DTYPES)}")

@@ -1,0 +1,61 @@
+"""Shared experiment assembly — what the reference copy-pastes 9×, built once.
+
+Every reference script repeats the same ~60 lines: seed, load/split data,
+tokenizer, loaders, model, optimizer (e.g. ``/root/reference/single-gpu-cls.py:
+207-255``).  Entry scripts here call these two functions and stay thin; the
+*strategy* (placement/sharding/launcher) is the only thing they add.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+
+from pdnlp_tpu.data import Collator, DataLoader, WordPieceTokenizer, load_data, split_data
+from pdnlp_tpu.data.sampler import DistributedShardSampler
+from pdnlp_tpu.data.tokenizer import get_or_build_vocab
+from pdnlp_tpu.models import bert, get_config
+from pdnlp_tpu.train.optim import build_optimizer
+from pdnlp_tpu.utils.seeding import set_seed
+
+
+def setup_data(args, *, num_shards: int = 1, shard_id: int = 0,
+               device_batch_mult: int = 1) -> Tuple[DataLoader, DataLoader, WordPieceTokenizer]:
+    """(train_loader, dev_loader, tokenizer).
+
+    ``device_batch_mult`` scales the per-host batch for single-controller
+    data parallelism (global batch = per-device 32 × #devices, so step count
+    matches the reference's ``DistributedSampler`` math: 288 single / 144 at
+    2-way).  ``num_shards``/``shard_id`` split the *dataset* across host
+    processes for the multi-process launcher variants.
+    """
+    data = load_data(args.data_path)
+    train, dev = split_data(data, seed=args.seed, limit=args.data_limit, ratio=args.ratio)
+    tok = WordPieceTokenizer(get_or_build_vocab(args))
+    col = Collator(tok, args.max_seq_len)
+    train_loader = DataLoader(
+        train, col, args.train_batch_size * device_batch_mult,
+        sampler=DistributedShardSampler(len(train), num_shards, shard_id,
+                                        shuffle=True, seed=args.seed),
+        prefetch=args.prefetch,
+    )
+    dev_loader = DataLoader(
+        dev, col, args.dev_batch_size * device_batch_mult,
+        sampler=DistributedShardSampler(len(dev), num_shards, shard_id, shuffle=False),
+        prefetch=args.prefetch,
+    )
+    return train_loader, dev_loader, tok
+
+
+def setup_model(args, vocab_size: int):
+    """(cfg, tx, state) — seeded the reference's way (one seed, 123)."""
+    from pdnlp_tpu.train.steps import init_state
+
+    cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
+                     dropout=args.dropout)
+    root = set_seed(args.seed)
+    init_key, train_rng = jax.random.split(root)
+    params = bert.init_params(init_key, cfg)
+    tx = build_optimizer(params, args)
+    state = init_state(init_key, cfg, tx, rng=train_rng, params=params)
+    return cfg, tx, state

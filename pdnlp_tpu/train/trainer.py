@@ -1,0 +1,118 @@
+"""The Trainer — train / dev / test with the reference's semantics.
+
+Twin of the per-script ``Trainer`` classes
+(``/root/reference/multi-gpu-distributed-cls.py:113-239``):
+
+- ``train``: epoch loop, per-step loss line ``【train】 epoch：e/E step：s/S
+  loss：x``, optional dev every ``eval_step`` with best-accuracy
+  checkpointing (``:183-192``), wall-clock ``耗时：X分钟`` at the end
+  (``:193-195``), end-of-run checkpoint when ``dev`` is off (``:196-197``).
+- ``dev``: eval over the dev loader -> (mean loss, accuracy) — the psum/
+  all-gather math happens inside the jitted eval step.
+- ``test``: dev + collected predictions for the classification report.
+
+TPU-specific behavior: the per-step loss is fetched lazily — jax dispatch is
+async, so ``float(loss)`` only blocks on steps that actually print
+(``log_every``), keeping the device queue full between log lines.  The
+reference instead syncs every step (`.item()` after an explicit barrier).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from pdnlp_tpu.train import checkpoint as ckpt
+from pdnlp_tpu.utils.logging import (
+    fmt_best, fmt_dev, fmt_elapsed_minutes, fmt_train, rank0_print,
+)
+
+
+class Trainer:
+    def __init__(
+        self,
+        args,
+        cfg,
+        state: Dict,
+        train_step: Callable,
+        eval_step: Callable,
+        put: Optional[Callable] = None,
+    ):
+        self.args = args
+        self.cfg = cfg
+        self.state = state
+        self.train_step = train_step
+        self.eval_step = eval_step
+        self.put = put or (lambda b: b)
+        self.best_accuracy = 0.0
+
+    # ------------------------------------------------------------------ train
+    def train(self, train_loader, dev_loader=None) -> float:
+        """Run ``args.epochs`` epochs; returns wall-clock minutes."""
+        args = self.args
+        total_step = len(train_loader) * args.epochs
+        gstep = 0
+        pending: Tuple[int, int, jax.Array] | None = None  # (epoch, gstep, loss)
+        start = time.time()
+        for epoch in range(1, args.epochs + 1):
+            train_loader.set_epoch(epoch - 1)
+            for batch in train_loader:
+                self.state, metrics = self.train_step(self.state, self.put(batch))
+                gstep += 1
+                if gstep % args.log_every == 0:
+                    if pending is not None:  # print the *previous* step's loss:
+                        e, s, l = pending     # it is done by now — no sync stall
+                        rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
+                    pending = (epoch, gstep, metrics["loss"])
+                if dev_loader is not None and args.dev and gstep % args.eval_step == 0:
+                    self._dev_and_maybe_save(dev_loader)
+        if pending is not None:
+            e, s, l = pending
+            rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
+        jax.block_until_ready(self.state["params"])
+        minutes = (time.time() - start) / 60
+        rank0_print(fmt_elapsed_minutes(minutes))
+        if not args.dev:
+            self._save(args.ckpt_path())
+        return minutes
+
+    def _dev_and_maybe_save(self, dev_loader) -> None:
+        loss, acc = self.dev(dev_loader)
+        rank0_print(fmt_dev(loss, acc))
+        if acc > self.best_accuracy:
+            self.best_accuracy = acc
+            self._save(self.args.ckpt_path())
+            rank0_print(fmt_best(acc))
+
+    def _save(self, path: str) -> None:
+        if jax.process_index() == 0:
+            ckpt.save_params(path, self.state)
+
+    # ------------------------------------------------------------------- eval
+    def _evaluate(self, loader, collect_preds: bool) -> Dict:
+        y_true, y_pred = [], []
+        loss_sum = weight = correct = 0.0
+        for batch in loader:
+            m = self.eval_step(self.state["params"], self.put(batch))
+            loss_sum += float(m["loss_sum"])
+            weight += float(m["weight"])
+            correct += float(m["correct"])
+            if collect_preds:
+                real = np.asarray(batch["example_weight"]) > 0  # drop filler rows
+                y_pred.extend(np.asarray(m["pred"])[real].tolist())
+                y_true.extend(np.asarray(batch["label"])[real].tolist())
+        weight = max(weight, 1.0)
+        return {"loss": loss_sum / weight, "accuracy": correct / weight,
+                "y_true": y_true, "y_pred": y_pred}
+
+    def dev(self, loader) -> Tuple[float, float]:
+        """(weighted mean loss, accuracy) over the dev set."""
+        r = self._evaluate(loader, collect_preds=False)
+        return r["loss"], r["accuracy"]
+
+    def test(self, loader) -> Dict:
+        """Eval + predictions: feeds the classification report
+        (``/root/reference/test.py:144-170``)."""
+        return self._evaluate(loader, collect_preds=True)

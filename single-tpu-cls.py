@@ -1,0 +1,38 @@
+"""Single-device training — strategy 1 of the capability matrix.
+
+Capability twin of ``/root/reference/single-gpu-cls.py``: one device, batch
+32, seq len 128, 1 epoch over the seeded 9,200-example split (288 steps),
+AdamW 3e-5, per-step ``【train】`` lines, ``耗时：X分钟`` wall-clock, final
+checkpoint, then a test pass with a per-class report.
+
+TPU-native shape: the whole step is one jitted XLA program on the chip; the
+loader prefetches/collates on the host thread while the device runs.
+
+    python single-tpu-cls.py [--dtype bfloat16] [--dev true] ...
+"""
+import jax
+
+from pdnlp_tpu.data.corpus import LABELS
+from pdnlp_tpu.train import Trainer, make_eval_step, make_train_step, setup_data, setup_model
+from pdnlp_tpu.utils.config import Args, parse_cli
+from pdnlp_tpu.utils.logging import rank0_print
+from pdnlp_tpu.utils.metrics import classification_report
+
+
+def main(args: Args) -> float:
+    train_loader, dev_loader, tok = setup_data(args)
+    cfg, tx, state = setup_model(args, tok.vocab_size)
+    rank0_print(f"device: {jax.devices()[0].platform}  model: {args.model}  "
+                f"dtype: {args.dtype}  steps/epoch: {len(train_loader)}")
+    trainer = Trainer(args, cfg, state,
+                      make_train_step(cfg, tx, args), make_eval_step(cfg, args))
+    minutes = trainer.train(train_loader, dev_loader)
+    # dev set doubles as the test set (single-gpu-cls.py:241-247)
+    result = trainer.test(dev_loader)
+    rank0_print(f"test loss：{result['loss']:.6f} accuracy：{result['accuracy']:.4f}")
+    rank0_print(classification_report(result["y_true"], result["y_pred"], LABELS))
+    return minutes
+
+
+if __name__ == "__main__":
+    main(parse_cli(base=Args(strategy="single")))
