@@ -1,0 +1,84 @@
+"""Training through the ``Accelerator`` convenience API — the HF Accelerate
+analog.
+
+Capability twin of ``/root/reference/multi-gpu-accelerate-cls.py``: the
+training loop below is written the way that script writes it — a local
+``Trainer`` class with ``on_step``/``train``/``dev`` built by the *user*,
+single-device style — and becomes distributed only through the three
+``Accelerator`` calls (``prepare``, ``compile_step``, ``compile_eval``),
+mirroring ``accelerator.prepare(model, optimizer, train_loader, dev_loader)``
+(``:289-294``).  Note ``total_step`` is the *global* step count, already
+divided by the device count via the re-batched loader — the reference
+highlights this division at ``:145,271``.
+
+    python multi-tpu-accelerate-cls.py [--dtype bfloat16]
+"""
+import time
+
+from pdnlp_tpu.data.corpus import LABELS
+from pdnlp_tpu.train import setup_data, setup_model
+from pdnlp_tpu.train.accel import Accelerator
+from pdnlp_tpu.train.steps import build_eval_step, build_train_step
+from pdnlp_tpu.utils.config import Args, parse_cli
+from pdnlp_tpu.utils.logging import fmt_elapsed_minutes, fmt_train
+from pdnlp_tpu.utils.metrics import classification_report
+
+
+def main(args: Args) -> float:
+    accelerator = Accelerator(args)
+
+    # user-style single-device setup (the reference's main() body)
+    train_loader, dev_loader, tok = setup_data(args)
+    cfg, tx, state = setup_model(args, tok.vocab_size)
+
+    # the one distributed-awareness step
+    state, train_loader, dev_loader = accelerator.prepare(
+        state, train_loader, dev_loader)
+    train_step = accelerator.compile_step(build_train_step(cfg, tx, args))
+    eval_step = accelerator.compile_eval(build_eval_step(cfg, args))
+
+    total_step = len(train_loader) * args.epochs
+    accelerator.print(f"devices: {accelerator.num_devices}  "
+                      f"steps/epoch: {len(train_loader)}")
+    start = time.time()
+    gstep = 0
+    metrics = None
+    for epoch in range(1, args.epochs + 1):
+        train_loader.set_epoch(epoch - 1)
+        for batch in train_loader:
+            state, metrics = train_step(state, batch)
+            gstep += 1
+            if gstep % args.log_every == 0:
+                accelerator.print(fmt_train(
+                    epoch, args.epochs, gstep, total_step,
+                    float(accelerator.gather(metrics["loss"]))))
+    if metrics is not None:
+        accelerator.gather(metrics["loss"])  # completion barrier
+    minutes = (time.time() - start) / 60
+    accelerator.print(fmt_elapsed_minutes(minutes))
+
+    # user-style eval loop over the prepared dev loader
+    y_true, y_pred = [], []
+    loss_sum = weight = correct = 0.0
+    for batch in dev_loader:
+        m = accelerator.gather(eval_step(state["params"], batch))
+        loss_sum += float(m["loss_sum"])
+        weight += float(m["weight"])
+        correct += float(m["correct"])
+        real = m["ew"] > 0
+        y_pred.extend(m["pred"][real].tolist())
+        y_true.extend(m["label"][real].tolist())
+    weight = max(weight, 1.0)
+    accelerator.print(f"test loss：{loss_sum / weight:.6f} "
+                      f"accuracy：{correct / weight:.4f}")
+    accelerator.print(classification_report(y_true, y_pred, LABELS))
+
+    from pdnlp_tpu.train import checkpoint as ckpt
+
+    # all processes enter (consolidate is collective); rank 0 writes
+    ckpt.save_params(args.ckpt_path(), state)
+    return minutes
+
+
+if __name__ == "__main__":
+    main(parse_cli(base=Args(strategy="accelerate")))

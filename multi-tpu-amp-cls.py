@@ -1,0 +1,19 @@
+"""Mesh data-parallel + bf16 — the DDP+AMP analog (the reference's fastest
+hand-rolled config, 0.6336 min, ``/root/reference/README.md:16``).
+
+Capability twin of ``/root/reference/multi-gpu-distributed-mp-amp-cls.py:
+160-175``: ``autocast`` becomes bf16 compute on the MXU (master params stay
+fp32; softmax/LayerNorm reduce fp32) and the dynamic ``GradScaler`` is
+**deleted, not ported** — bf16 carries fp32's exponent range, so nothing
+underflows and no loss scaling is needed (see ``train/precision.py``).
+The reference's known quirk of never calling ``zero_grad`` in this script
+(``:168-181``) is documented, not replicated — grads here are fresh by
+construction (``jax.grad`` is functional).
+
+    python multi-tpu-amp-cls.py
+"""
+from pdnlp_tpu.train.run import run_parallel
+from pdnlp_tpu.utils.config import Args, parse_cli
+
+if __name__ == "__main__":
+    run_parallel(parse_cli(base=Args(strategy="amp", dtype="bfloat16")), mode="dp")

@@ -1,0 +1,19 @@
+"""Single-process batch-scatter training — the ``nn.DataParallel`` analog.
+
+Capability twin of ``/root/reference/multi-gpu-dataparallel-cls.py:255``:
+one controller process, the SAME 32-row global batch as single-device,
+scattered across chips each step (so the step count stays 288 — the
+reference's DataParallel does not shrink steps, ``README.md:44-74``).
+On TPU this is the same jitted program as DP with a smaller per-device
+batch; the scatter/gather the reference does per step is just the batch's
+sharding.  Expect it to beat single-device but lose to ``multi-tpu-jax-cls``
+— same relative ordering as the reference's table (2.03 vs 1.41 min).
+
+    python multi-tpu-dataparallel-cls.py
+"""
+from pdnlp_tpu.train.run import run_parallel
+from pdnlp_tpu.utils.config import Args, parse_cli
+
+if __name__ == "__main__":
+    run_parallel(parse_cli(base=Args(strategy="dataparallel")),
+                 mode="dp", scale_batch=False)

@@ -1,0 +1,17 @@
+"""Explicit-collectives training — the Horovod analog.
+
+Capability twin of ``/root/reference/multi-gpu-horovod-cls.py``: instead of
+letting XLA insert collectives from shardings, the train step is written
+per-device under ``shard_map`` with hand-coded ``lax.psum`` gradient
+averaging — compressed to bf16 on the wire, the twin of
+``hvd.Compression.fp16`` (``:344-349``).  Parameter broadcast from rank 0
+(``:338-343``) is the replicated state placement itself.
+
+    python multi-tpu-shardmap-cls.py [--dtype bfloat16]
+"""
+from pdnlp_tpu.train.run import run_parallel
+from pdnlp_tpu.utils.config import Args, parse_cli
+
+if __name__ == "__main__":
+    run_parallel(parse_cli(base=Args(strategy="shardmap")),
+                 mode="dp", explicit_collectives=True)

@@ -1,0 +1,59 @@
+"""Self-spawning multi-process launcher — the ``mp.spawn`` analog.
+
+Capability twin of ``/root/reference/multi-gpu-distributed-mp-cls.py:361``:
+one command forks ``--num_processes`` worker processes that rendezvous over
+TCP (``init_method="tcp://localhost:12345"`` -> ``jax.distributed.initialize``
+with a localhost coordinator) and run the same mesh-DP training as
+``multi-tpu-jax-cls.py``.  The parent is only a process manager, exactly like
+``mp.spawn``.
+
+On a TPU pod each host instead runs one process (use multi-tpu-jax-cls.py
+with ``--coordinator_address``); this single-command spawn flavor is for
+multi-process runs on one machine and is exercised in CI on the CPU backend,
+where each worker owns a slice of virtual devices.
+
+    python multi-tpu-spawn-cls.py --num_processes 2
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from pdnlp_tpu.train.run import run_parallel
+from pdnlp_tpu.utils.config import Args, parse_cli
+
+_PORT = 12355  # the tcp://localhost:12345 analog (different port: CI safety)
+
+
+def spawn(args) -> int:
+    """Fork ``num_processes`` copies of this script with PROCESS_ID set
+    (the ``mp.spawn(main_worker, nprocs=N)`` analog)."""
+    procs = []
+    for pid in range(args.num_processes):
+        env = dict(os.environ)
+        env.update(
+            COORDINATOR_ADDRESS=f"localhost:{_PORT}",
+            NUM_PROCESSES=str(args.num_processes),
+            PROCESS_ID=str(pid),
+        )
+        procs.append(subprocess.Popen([sys.executable, __file__, *sys.argv[1:]],
+                                      env=env))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def main() -> int:
+    args = parse_cli(base=Args(strategy="spawn"))
+    already_child = os.environ.get("PROCESS_ID") is not None
+    if args.num_processes and args.num_processes > 1 and not already_child \
+            and args.process_id is None:
+        return spawn(args)
+    run_parallel(args, mode="dp")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

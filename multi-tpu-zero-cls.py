@@ -1,0 +1,19 @@
+"""Fully-sharded training — the DeepSpeed ZeRO-3 analog.
+
+Capability twin of ``/root/reference/multi-gpu-deepspeed-cls.py:220-247``:
+every parameter and Adam moment is sharded along the data axis from init
+(``allgather_partitions`` -> XLA all-gather-before-use; ``reduce_scatter``
+-> XLA reduce-scatter of grads; the partitioned init of
+``deepspeed.initialize`` -> jit-init with ``out_shardings``).  Activation
+checkpointing (``:240-244``) is ``--remat true`` (default here), via
+``jax.checkpoint`` around the scanned layer body.  Checkpoints consolidate
+to the same single-file format as every other strategy — the
+``zero_to_fp32.py`` analog is ``checkpoint.consolidate``.
+
+    python multi-tpu-zero-cls.py [--dtype bfloat16] [--remat false]
+"""
+from pdnlp_tpu.train.run import run_parallel
+from pdnlp_tpu.utils.config import Args, parse_cli
+
+if __name__ == "__main__":
+    run_parallel(parse_cli(base=Args(strategy="zero", remat=True)), mode="zero")

@@ -127,8 +127,12 @@ def build_vocab(
 
 def save_vocab(vocab: Sequence[str], path: str) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
+    # atomic write: concurrent processes (multi-host launch) each build the
+    # same deterministic vocab; rename makes the race harmless
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
         f.write("\n".join(vocab) + "\n")
+    os.replace(tmp, path)
 
 
 def load_vocab(path: str) -> List[str]:

@@ -52,7 +52,11 @@ def get_config(name: str, vocab_size: Optional[int] = None,
                num_labels: Optional[int] = None, **overrides) -> BertConfig:
     """Look up a registered architecture, overriding data-dependent fields
     (vocab size comes from the corpus-built vocab at runtime)."""
-    cfg = _REGISTRY[name]
+    try:
+        cfg = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; use one of {available_models()}") from None
     kw = dict(overrides)
     if vocab_size is not None:
         kw["vocab_size"] = vocab_size

@@ -1,0 +1,23 @@
+"""Parallelism layer: mesh construction, sharding rules, collectives, and
+multi-process runtime — the TPU-native replacement for the reference's
+NCCL/DDP/Horovod/DeepSpeed machinery (``SURVEY.md`` §2.3-2.4)."""
+from pdnlp_tpu.parallel.collectives import (
+    barrier, grad_reduce, loss_reduce, make_global_batch, output_reduce,
+)
+from pdnlp_tpu.parallel.execution import (
+    make_parallel_eval_step, make_parallel_train_step, make_shardmap_train_step,
+    setup_sharded_model,
+)
+from pdnlp_tpu.parallel.mesh import DATA_AXIS, local_batch_mult, make_mesh
+from pdnlp_tpu.parallel.runtime import init_runtime
+from pdnlp_tpu.parallel.sharding import (
+    batch_sharding, replicated, shard_fraction, state_shardings,
+)
+
+__all__ = [
+    "DATA_AXIS", "barrier", "batch_sharding", "grad_reduce", "init_runtime",
+    "local_batch_mult", "loss_reduce", "make_global_batch", "make_mesh",
+    "make_parallel_eval_step", "make_parallel_train_step",
+    "make_shardmap_train_step", "output_reduce", "replicated",
+    "setup_sharded_model", "shard_fraction", "state_shardings",
+]

@@ -1,0 +1,78 @@
+"""Collective helpers — the NCCL ``all_reduce`` / ``all_gather`` twins.
+
+The reference reduces the step loss and gathers eval outputs explicitly
+(``loss_reduce`` / ``output_reduce``, ``/root/reference/multi-gpu-distributed-
+cls.py:139-155``) and syncs ranks with ``dist.barrier()`` (``:171``).  On TPU
+these become ``lax`` collectives compiled onto ICI — used *explicitly* only
+inside ``shard_map`` bodies (the Horovod-style path); the jit/NamedSharding
+path gets the same collectives inserted by XLA from sharding annotations.
+
+``make_global_batch`` is the ``DistributedSampler`` + host->device half: each
+process feeds its local shard of the batch and the result is ONE global
+``jax.Array`` laid out along the mesh's data axis.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pdnlp_tpu.parallel.mesh import DATA_AXIS
+
+
+def loss_reduce(loss: jax.Array, axis: str = DATA_AXIS) -> jax.Array:
+    """Mean over data-parallel shards (``dist.all_reduce(SUM)/world_size``,
+    ``multi-gpu-distributed-cls.py:139-143``)."""
+    return lax.pmean(loss, axis)
+
+
+def grad_reduce(grads, axis: str = DATA_AXIS, compress_dtype=None):
+    """Mean-reduce a gradient pytree across the data axis.
+
+    ``compress_dtype=jnp.bfloat16`` reduces in bf16 — the wire-compression
+    analog of Horovod's ``hvd.Compression.fp16``
+    (``/root/reference/multi-gpu-horovod-cls.py:344-349``)."""
+
+    def red(g):
+        if compress_dtype is not None:
+            return lax.pmean(g.astype(compress_dtype), axis).astype(g.dtype)
+        return lax.pmean(g, axis)
+
+    return jax.tree_util.tree_map(red, grads)
+
+
+def output_reduce(outputs: jax.Array, targets: jax.Array, axis: str = DATA_AXIS):
+    """All-gather per-shard eval outputs into global arrays
+    (``dist.all_gather``, ``multi-gpu-distributed-cls.py:145-155``)."""
+    return (lax.all_gather(outputs, axis, tiled=True),
+            lax.all_gather(targets, axis, tiled=True))
+
+
+def barrier() -> None:
+    """Host-level sync across processes (the ``dist.barrier()`` analog).
+    Device-side ordering needs no barrier — XLA program order provides it."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("pdnlp_tpu.barrier")
+
+
+def make_global_batch(mesh: Mesh, axis: str = DATA_AXIS
+                      ) -> Callable[[Dict], Dict[str, jax.Array]]:
+    """Returns ``put(batch)``: host-local numpy batch -> global ``jax.Array``
+    dict sharded along the data axis.  Single-process: the full batch is
+    scattered over local devices.  Multi-process: each host contributes its
+    shard (built by ``DistributedShardSampler``) and the global array spans
+    hosts — no gather ever materializes on one device."""
+    sharding = NamedSharding(mesh, P(axis))
+
+    def put(batch: Dict) -> Dict[str, jax.Array]:
+        return {
+            k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in batch.items()
+        }
+
+    return put

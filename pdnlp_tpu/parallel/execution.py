@@ -1,0 +1,140 @@
+"""Sharded experiment assembly + compiled parallel steps.
+
+This is where strategy becomes *placement*: the same step functions from
+``train.steps`` get compiled with explicit mesh shardings.
+
+- ``setup_sharded_model``: init the train state **already sharded** — the
+  shardings are computed from ``jax.eval_shape`` (no memory), then the init
+  runs under ``jit`` with ``out_shardings``, so a ZeRO run never materializes
+  a full replica (the analog of DeepSpeed partitioning params at init,
+  ``/root/reference/multi-gpu-deepspeed-cls.py:296-302``).
+- ``make_parallel_train_step`` / ``make_parallel_eval_step``: ``jit`` with
+  in/out shardings — XLA inserts the gradient all-reduce (DDP's NCCL hooks)
+  or all-gather/reduce-scatter (ZeRO-3) on ICI.
+- ``make_shardmap_train_step``: the explicit-collectives flavor (Horovod
+  analog, ``/root/reference/multi-gpu-horovod-cls.py:338-350``): per-device
+  code with hand-written ``psum`` of bf16-compressed gradients.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pdnlp_tpu.models import BertConfig, bert, get_config
+from pdnlp_tpu.parallel import collectives
+from pdnlp_tpu.parallel.mesh import DATA_AXIS
+from pdnlp_tpu.parallel.sharding import batch_sharding, replicated, state_shardings
+from pdnlp_tpu.train.optim import build_optimizer
+from pdnlp_tpu.train.precision import resolve_dtype
+from pdnlp_tpu.train.steps import (
+    State, build_eval_step, build_train_step, init_state, weighted_ce,
+)
+from pdnlp_tpu.utils.seeding import set_seed
+
+
+def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp"
+                        ) -> Tuple[BertConfig, optax.GradientTransformation, State, Any]:
+    """(cfg, tx, state, shardings) — state lives on the mesh from birth."""
+    cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
+                     dropout=args.dropout, attn_dropout=args.attn_dropout)
+    root = set_seed(args.seed)
+    init_key, train_rng = jax.random.split(root)
+
+    # tx needs a params *structure* for the weight-decay mask — shapes only.
+    param_shapes = jax.eval_shape(lambda k: bert.init_params(k, cfg), init_key)
+    tx = build_optimizer(param_shapes, args)
+
+    def init_fn(key, rng):
+        params = bert.init_params(key, cfg)
+        return init_state(key, cfg, tx, rng=rng, params=params)
+
+    state_shapes = jax.eval_shape(init_fn, init_key, train_rng)
+    shardings = state_shardings(state_shapes, mesh, mode)
+    state = jax.jit(init_fn, out_shardings=shardings)(init_key, train_rng)
+    return cfg, tx, state, shardings
+
+
+def make_parallel_train_step(cfg: BertConfig, tx, args, mesh: Mesh, shardings):
+    """Compile the fused train step over the mesh.  DP vs ZeRO is entirely
+    encoded in ``shardings`` — the step function is identical."""
+    fn = build_train_step(cfg, tx, args)
+    return jax.jit(
+        fn,
+        donate_argnums=0,
+        in_shardings=(shardings, batch_sharding(mesh)),
+        out_shardings=(shardings, replicated(mesh)),
+    )
+
+
+def make_parallel_eval_step(cfg: BertConfig, args, mesh: Mesh, param_shardings):
+    """Eval step over the mesh; outputs replicated so every host can read
+    them (the ``output_reduce`` all-gather, ``multi-gpu-distributed-cls.py:
+    145-155``, inserted by XLA)."""
+    fn = build_eval_step(cfg, args)
+    return jax.jit(
+        fn,
+        in_shardings=(param_shardings, batch_sharding(mesh)),
+        out_shardings=replicated(mesh),
+    )
+
+
+def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
+                             compress_grads: bool = True):
+    """Explicit-collectives train step (Horovod analog).
+
+    Per-device body: local forward/backward on the batch shard, then a
+    hand-written weighted ``psum`` of gradients — optionally compressed to
+    bf16 on the wire (``hvd.Compression.fp16``,
+    ``/root/reference/multi-gpu-horovod-cls.py:344-349``) — then an identical
+    replicated optimizer update on every device.
+
+    Exactness: the global loss is sum(w*ce)/sum(w) over the *global* batch.
+    Each shard computes its local weighted-mean grad; shards are then
+    combined weighted by their local weight mass, which reproduces the
+    global-mean gradient exactly even when filler rows make shards uneven.
+    """
+    dtype = resolve_dtype(args.dtype)
+    remat = bool(args.remat)
+    attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
+    compress = jnp.bfloat16 if compress_grads else None
+
+    def local_loss(params, batch, rng):
+        logits = bert.classify(params, cfg, batch, dtype=dtype, deterministic=False,
+                               rng=rng, remat=remat, attn_impl=attn_impl)
+        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"])
+        return loss, (correct, batch["example_weight"].sum())
+
+    def per_device(state: State, batch) -> Tuple[State, Dict[str, jax.Array]]:
+        # distinct dropout stream per shard, common stream per step
+        rng = jax.random.fold_in(state["rng"], state["step"])
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
+        (loss, (correct, lw)), grads = jax.value_and_grad(
+            local_loss, has_aux=True)(state["params"], batch, rng)
+        gw = jax.lax.psum(lw, DATA_AXIS)
+        scale = lw / gw
+        grads = jax.tree_util.tree_map(
+            lambda g: (jax.lax.psum((g * scale).astype(compress), DATA_AXIS)
+                       .astype(g.dtype)) if compress is not None
+            else jax.lax.psum(g * scale, DATA_AXIS),
+            grads,
+        )
+        loss = jax.lax.psum(loss * scale, DATA_AXIS)
+        acc = jax.lax.psum(correct, DATA_AXIS) / gw
+        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {"params": params, "opt_state": opt_state,
+                     "step": state["step"] + 1, "rng": state["rng"]}
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    batch_specs = P(DATA_AXIS)
+    mapped = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), batch_specs),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=0)

@@ -1,0 +1,76 @@
+"""Device-mesh construction — the process-group / communicator analog.
+
+The reference's runtime layer is ``dist.init_process_group("nccl")`` plus an
+implicit all-device communicator (``/root/reference/multi-gpu-distributed-cls.py:284``).
+The TPU-native twin is a ``jax.sharding.Mesh``: a named, possibly
+multi-dimensional arrangement of devices over which ``jit`` lays out arrays
+and inserts ICI collectives.  One ``('data',)`` axis reproduces the
+reference's pure data-parallel world; extra axes (``model``/``seq``) are how
+the same machinery extends beyond it.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+
+def make_mesh(
+    num_devices: Optional[int] = None,
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over the local view of devices.
+
+    ``shape`` maps axis name -> size (one ``-1`` entry = inferred), defaulting
+    to a 1-D ``('data',)`` mesh over every visible device — the TPU twin of
+    "one NCCL rank per GPU".  ``num_devices`` caps the device count (the
+    ``--nproc_per_node`` analog, ``/root/reference/README.md:81-86``).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(f"asked for {num_devices} devices, have {len(devices)}")
+        devices = devices[:num_devices]
+    if not shape:
+        shape = {DATA_AXIS: len(devices)}
+
+    names = tuple(shape)
+    dims = [int(shape[n]) for n in names]
+    if dims.count(-1) > 1:
+        raise ValueError(f"at most one inferred (-1) axis: {shape}")
+    if -1 in dims:
+        known = int(np.prod([d for d in dims if d != -1])) or 1
+        if len(devices) % known:
+            raise ValueError(f"{len(devices)} devices not divisible by {shape}")
+        dims[dims.index(-1)] = len(devices) // known
+    total = int(np.prod(dims)) if dims else 1
+    if total > len(devices):
+        raise ValueError(f"mesh {dict(zip(names, dims))} needs {total} devices, "
+                         f"have {len(devices)}")
+
+    try:
+        # topology-aware layout (rides ICI neighbours on real TPU slices)
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(tuple(dims), devices=devices[:total])
+    except Exception:
+        dev_array = np.asarray(devices[:total]).reshape(dims)
+    return Mesh(dev_array, names)
+
+
+def local_batch_mult(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    """How many data-axis shards this *process* feeds — scales the per-host
+    batch so global batch = per-device batch x axis size (the step-count math
+    of ``DistributedSampler``: 288 single / 144 at 2-way, ``SURVEY.md`` §6).
+    Assumes the data axis divides evenly across processes, which holds for
+    standard pod topologies (one process per host, hosts x chips = mesh)."""
+    nproc = jax.process_count()
+    size = mesh.shape[axis]
+    if size % nproc:
+        raise ValueError(f"data axis {size} not divisible by {nproc} processes")
+    return size // nproc

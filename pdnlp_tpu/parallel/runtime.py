@@ -1,0 +1,65 @@
+"""Multi-process runtime init — the rendezvous layer.
+
+Twin of the reference's two rendezvous modes: env-var
+``dist.init_process_group`` (``/root/reference/multi-gpu-distributed-cls.py:
+275-284``) and explicit TCP (``multi-gpu-distributed-mp-cls.py:265-266``).
+JAX collapses both into ``jax.distributed.initialize(coordinator, n, id)``;
+afterwards every process sees the global device set and ``jit`` programs are
+single-program-multiple-data across hosts (DCN for cross-host, ICI within).
+"""
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+
+def init_runtime(args) -> Tuple[int, int]:
+    """Initialize multi-process JAX if configured; returns
+    ``(process_index, process_count)``.
+
+    Config precedence: explicit ``Args`` fields, then the standard env vars
+    (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID`` — the
+    MASTER_ADDR/WORLD_SIZE/RANK analog), else single-process.
+    """
+    import jax
+
+    _honor_platform_env()
+    coord = args.coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    nproc = args.num_processes or _int_env("NUM_PROCESSES")
+    pid = args.process_id if args.process_id is not None else _int_env("PROCESS_ID")
+
+    if coord and nproc and nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc),
+            process_id=int(pid or 0),
+        )
+    return jax.process_index(), jax.process_count()
+
+
+def _int_env(name: str):
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def _honor_platform_env() -> None:
+    """Re-apply ``JAX_PLATFORMS=cpu`` + the XLA virtual-device-count flag via
+    ``jax.config``.  This image's sitecustomize force-registers the TPU
+    plugin at interpreter start, which silently overrides the standard env
+    vars — so CPU-mesh runs (CI, spawn-launcher workers) would land on the
+    single TPU chip instead of N virtual devices.  No-op once the backend
+    is initialized."""
+    import re
+
+    import jax
+
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+        return
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        m = re.search(r"xla_force_host_platform_device_count=(\d+)",
+                      os.environ.get("XLA_FLAGS", ""))
+        if m:
+            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+    except RuntimeError:
+        pass

@@ -1,0 +1,118 @@
+"""``Accelerator`` — the HF Accelerate analog: write single-device code,
+call ``prepare``, and it runs distributed.
+
+Capability twin of ``/root/reference/multi-gpu-accelerate-cls.py:289-294``:
+``Accelerator()`` detects the runtime; ``prepare(...)`` wraps the pieces the
+user already built (state pytree, data loaders, step functions) so the same
+hand-written training loop executes data-parallel over the whole mesh.  The
+reference's ``accelerator.backward(loss)`` has no TPU twin because backward
+is inside the jitted step; what ``prepare`` does instead is (a) re-batch the
+loaders to the global batch (the auto-sharded DataLoader analog, which is
+also why the reference divides ``total_step`` by device count, ``:145,271``),
+(b) shard/replicate the state onto the mesh, and (c) compile user step
+functions with the right in/out shardings.
+
+Unlike ``train.run.build_parallel_trainer`` (which wires this framework's
+own ``Trainer``), ``Accelerator`` distributes *your* functions and *your*
+loop — see ``multi-tpu-accelerate-cls.py`` for the loop written in reference
+style.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+from pdnlp_tpu.data.loader import DataLoader
+from pdnlp_tpu.parallel import (
+    init_runtime, local_batch_mult, make_global_batch, make_mesh,
+)
+from pdnlp_tpu.parallel.sharding import batch_sharding, replicated, state_shardings
+
+
+class _PreparedLoader:
+    """A loader whose batches arrive as global, mesh-sharded ``jax.Array``s."""
+
+    def __init__(self, loader: DataLoader, put: Callable):
+        self._loader = loader
+        self._put = put
+
+    def __len__(self):
+        return len(self._loader)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._loader.set_epoch(epoch)
+
+    def __iter__(self):
+        for batch in self._loader:
+            yield self._put(batch)
+
+
+class Accelerator:
+    """Runtime detection + ``prepare``; mirrors the 4-line setup of the
+    reference script (``Accelerator()`` then one ``prepare`` call)."""
+
+    def __init__(self, args=None, mode: str = "dp"):
+        if args is not None:
+            init_runtime(args)
+        self.mode = mode
+        self.mesh = make_mesh(
+            num_devices=getattr(args, "num_devices", None) if args else None,
+            shape=getattr(args, "mesh_shape", None) if args else None,
+        )
+        self.put = make_global_batch(self.mesh)
+        self.num_devices = self.mesh.size
+        self.process_index = jax.process_index()
+        self.is_main_process = self.process_index == 0
+        self._shardings = None
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, state: Any, *loaders: DataLoader) -> Tuple:
+        """(state, *loaders) distributed: state placed on the mesh under the
+        chosen mode, loaders re-batched to global batch and yielding sharded
+        arrays.  Mirrors ``model, optimizer, loaders = accelerator.prepare(...)``."""
+        self._shardings = state_shardings(state, self.mesh, self.mode)
+        state = jax.device_put(state, self._shardings)
+        mult = local_batch_mult(self.mesh)
+        prepared = []
+        for loader in loaders:
+            scaled = DataLoader(
+                loader.data, loader.collator, loader.batch_size * mult,
+                sampler=loader.sampler, drop_last=loader.drop_last,
+                prefetch=loader.prefetch,
+            )
+            prepared.append(_PreparedLoader(scaled, self.put))
+        return (state, *prepared)
+
+    def compile_step(self, fn: Callable, donate_state: bool = True) -> Callable:
+        """Compile a user train step ``fn(state, batch) -> (state, metrics)``
+        over the mesh (the ``accelerator.backward`` + DDP-wrapping analog:
+        XLA inserts the gradient all-reduce)."""
+        if self._shardings is None:
+            raise RuntimeError("call prepare(state, ...) before compile_step")
+        return jax.jit(
+            fn,
+            donate_argnums=0 if donate_state else (),
+            in_shardings=(self._shardings, batch_sharding(self.mesh)),
+            out_shardings=(self._shardings, replicated(self.mesh)),
+        )
+
+    def compile_eval(self, fn: Callable) -> Callable:
+        """Compile a user eval step ``fn(params, batch) -> metrics``."""
+        if self._shardings is None:
+            raise RuntimeError("call prepare(state, ...) before compile_eval")
+        return jax.jit(
+            fn,
+            in_shardings=(self._shardings["params"], batch_sharding(self.mesh)),
+            out_shardings=replicated(self.mesh),
+        )
+
+    # ------------------------------------------------------------- helpers
+    def gather(self, x) -> Any:
+        """Fetch a (replicated) device value to the host — also the true
+        completion barrier (see ``Trainer.train``)."""
+        return jax.device_get(x)
+
+    def print(self, *a, **kw) -> None:
+        if self.is_main_process:
+            print(*a, **kw)

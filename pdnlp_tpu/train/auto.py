@@ -1,0 +1,162 @@
+"""Declarative managed trainer — the HF ``Trainer``/``TrainingArguments``
+analog.
+
+Capability twin of ``/root/reference/multi-gpu-transformers-cls.py:150-184``:
+the user states *what* they want in a frozen ``TrainerArgs`` (step-based
+eval/save cadence, precision, best-model tracking, seed) and ``AutoTrainer``
+owns the whole run: loop, eval every ``eval_steps``, a rotating
+``checkpoint-<step>`` directory per save (``save_steps``/``save_total_limit``),
+``load_best_model_at_end`` with ``metric_for_best_model``, and a
+``compute_metrics`` hook (``:91-96``).  Parallelism is the framework's mesh
+DP — the analog of HF Trainer's implicit DDP — plus ``mode="zero"`` for
+fully-sharded, a knob HF Trainer delegates to DeepSpeed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from pdnlp_tpu.train import checkpoint as ckpt
+from pdnlp_tpu.utils.config import Args
+from pdnlp_tpu.utils.logging import rank0_print
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerArgs:
+    """The ``TrainingArguments`` twin (reference fields at
+    ``multi-gpu-transformers-cls.py:150-168``)."""
+
+    output_dir: str = "output/auto"
+    num_train_epochs: int = 1
+    per_device_train_batch_size: int = 32
+    per_device_eval_batch_size: int = 32
+    learning_rate: float = 3e-5
+    weight_decay: float = 0.01
+    eval_steps: int = 50                  # evaluation_strategy="steps"
+    save_steps: int = 50
+    save_total_limit: Optional[int] = 3
+    logging_steps: int = 10
+    bf16: bool = False                    # fp16=True analog
+    seed: int = 123
+    load_best_model_at_end: bool = True
+    metric_for_best_model: str = "accuracy"
+    greater_is_better: bool = True
+    mode: str = "dp"                      # "zero" = the DeepSpeed delegation
+    model: str = "bert-base"
+    data_path: str = "/root/reference/data/train.json"
+    data_limit: int = 10_000
+    max_seq_len: int = 128
+
+    def to_args(self) -> Args:
+        return Args(
+            strategy=f"auto-{self.mode}",
+            model=self.model,
+            data_path=self.data_path,
+            output_dir=self.output_dir,
+            train_batch_size=self.per_device_train_batch_size,
+            dev_batch_size=self.per_device_eval_batch_size,
+            learning_rate=self.learning_rate,
+            weight_decay=self.weight_decay,
+            epochs=self.num_train_epochs,
+            seed=self.seed,
+            eval_step=self.eval_steps,
+            log_every=self.logging_steps,
+            dtype="bfloat16" if self.bf16 else "float32",
+            data_limit=self.data_limit,
+            max_seq_len=self.max_seq_len,
+        )
+
+
+def default_compute_metrics(preds: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
+    """The reference's ``compute_metrics`` (argmax accuracy, ``:91-96``)."""
+    return {"accuracy": float((preds == labels).mean()) if len(labels) else 0.0}
+
+
+class AutoTrainer:
+    """Fully-managed: ``AutoTrainer(targs).train()`` then ``.evaluate()``."""
+
+    def __init__(self, targs: TrainerArgs,
+                 compute_metrics: Callable[..., Dict[str, float]] = None):
+        from pdnlp_tpu.train.run import build_parallel_trainer
+
+        self.targs = targs
+        self.args = targs.to_args()
+        self.compute_metrics = compute_metrics or default_compute_metrics
+        self._trainer, self.train_loader, self.dev_loader = build_parallel_trainer(
+            self.args, mode=targs.mode)
+        self.state_history: List[Tuple[int, str]] = []  # (step, ckpt_dir)
+        self.best_metric: Optional[float] = None
+        self.best_ckpt: Optional[str] = None
+
+    # ---------------------------------------------------------------- train
+    def train(self) -> Dict[str, float]:
+        t = self._trainer
+        targs = self.targs
+        gstep = 0
+        total = len(self.train_loader) * targs.num_train_epochs
+        start = time.time()
+        for epoch in range(1, targs.num_train_epochs + 1):
+            self.train_loader.set_epoch(epoch - 1)
+            for batch in self.train_loader:
+                t.state, metrics = t.train_step(t.state, t.put(batch))
+                gstep += 1
+                if gstep % targs.logging_steps == 0:
+                    rank0_print(f"step {gstep}/{total} "
+                                f"loss {float(metrics['loss']):.4f}")
+                if gstep % targs.eval_steps == 0:
+                    self._eval_and_log(gstep)
+                if gstep % targs.save_steps == 0:
+                    self._save_checkpoint(gstep)
+        float(jax.device_get(metrics["loss"]))  # completion barrier
+        runtime = time.time() - start
+        if targs.load_best_model_at_end and self.best_ckpt:
+            path = os.path.join(self.best_ckpt, "model.msgpack")
+            t.state["params"] = ckpt.load_params(path, t.state["params"])
+            rank0_print(f"loaded best model ({targs.metric_for_best_model}="
+                        f"{self.best_metric:.4f}) from {self.best_ckpt}")
+        n_examples = total * self.args.train_batch_size
+        return {"train_runtime": runtime,
+                "train_samples_per_second": n_examples / runtime,
+                "global_step": gstep}
+
+    # ----------------------------------------------------------------- eval
+    def evaluate(self) -> Dict[str, float]:
+        r = self._trainer.test(self.dev_loader)
+        m = self.compute_metrics(np.asarray(r["y_pred"]), np.asarray(r["y_true"]))
+        return {"eval_loss": r["loss"], **{f"eval_{k}": v for k, v in m.items()}}
+
+    def _eval_and_log(self, gstep: int) -> None:
+        m = self.evaluate()
+        rank0_print("  ".join(f"{k} {v:.4f}" for k, v in m.items()))
+        key = f"eval_{self.targs.metric_for_best_model}"
+        val = m.get(key)
+        if val is None:
+            return
+        better = (self.best_metric is None
+                  or (val > self.best_metric) == self.targs.greater_is_better)
+        if better:
+            self.best_metric = val
+            self.best_ckpt = self._ckpt_dir(gstep)
+
+    # ----------------------------------------------------------- checkpoints
+    def _ckpt_dir(self, gstep: int) -> str:
+        return os.path.join(self.targs.output_dir, f"checkpoint-{gstep}")
+
+    def _save_checkpoint(self, gstep: int) -> None:
+        d = self._ckpt_dir(gstep)
+        # all processes enter (consolidate is collective); rank 0 writes
+        ckpt.save_params(os.path.join(d, "model.msgpack"), self._trainer.state)
+        self.state_history.append((gstep, d))
+        if jax.process_index() != 0:
+            return
+        limit = self.targs.save_total_limit
+        while limit and len(self.state_history) > limit:
+            _, old = self.state_history.pop(0)
+            if old != self.best_ckpt:  # never rotate away the best model
+                shutil.rmtree(old, ignore_errors=True)

@@ -42,17 +42,27 @@ def consolidate(tree):
     return jax.tree_util.tree_map(fetch, tree)
 
 
-def _wrap_rng(tree: Dict[str, Any], unwrap: bool = False) -> Dict[str, Any]:
-    """PRNG key arrays don't serialize; store key_data and rewrap on load."""
+def _wrap_rng(tree: Dict[str, Any]) -> Dict[str, Any]:
+    """PRNG key arrays don't serialize; store key_data (rewrapped in load)."""
     out = dict(tree)
-    if not unwrap and "rng" in out:
+    if "rng" in out:
         out["rng"] = jax.random.key_data(out["rng"])
     return out
 
 
 def save(path: str, tree) -> None:
+    """Consolidate + write.
+
+    EVERY process must call this (consolidate runs a collective all-gather
+    for cross-host shards); only process 0 touches the filesystem — the
+    rank-0-writes split of ``multi-gpu-distributed-cls.py:192,196-197``
+    without its deadlock risk.
+    """
+    data_tree = consolidate(_wrap_rng(tree) if isinstance(tree, dict) else tree)
+    if jax.process_index() != 0:
+        return
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    data = serialization.to_bytes(consolidate(_wrap_rng(tree) if isinstance(tree, dict) else tree))
+    data = serialization.to_bytes(data_tree)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
@@ -60,10 +70,23 @@ def save(path: str, tree) -> None:
 
 
 def load(path: str, like) -> Any:
-    """Restore a pytree with the structure/dtypes of ``like``."""
+    """Restore a pytree with the structure/dtypes of ``like``.
+
+    Raises ``ValueError`` on leaf-shape mismatch — flax ``from_bytes`` does
+    not validate shapes, which would defer the failure to an opaque XLA
+    error at the next forward pass (e.g. loading a ``bert-tiny`` checkpoint
+    into a ``bert-base`` template).
+    """
     template = _wrap_rng(like) if isinstance(like, dict) and "rng" in like else like
     with open(path, "rb") as f:
         restored = serialization.from_bytes(template, f.read())
+    got_shapes = [getattr(l, "shape", None) for l in jax.tree_util.tree_leaves(restored)]
+    want_shapes = [getattr(l, "shape", None) for l in jax.tree_util.tree_leaves(template)]
+    if got_shapes != want_shapes:
+        bad = next((g, w) for g, w in zip(got_shapes, want_shapes) if g != w)
+        raise ValueError(
+            f"checkpoint {path!r} does not match the model template: "
+            f"first mismatching leaf shape {bad[0]} vs expected {bad[1]}")
     if isinstance(restored, dict) and "rng" in restored and isinstance(like, dict):
         restored = dict(restored)
         restored["rng"] = jax.random.wrap_key_data(restored["rng"])

@@ -52,7 +52,7 @@ def setup_model(args, vocab_size: int):
     from pdnlp_tpu.train.steps import init_state
 
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
-                     dropout=args.dropout)
+                     dropout=args.dropout, attn_dropout=args.attn_dropout)
     root = set_seed(args.seed)
     init_key, train_rng = jax.random.split(root)
     params = bert.init_params(init_key, cfg)

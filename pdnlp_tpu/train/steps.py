@@ -57,9 +57,10 @@ def weighted_ce(logits: jax.Array, labels: jax.Array, weights: jax.Array
     return loss, correct
 
 
-def make_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args
-                    ) -> Callable[[State, Dict[str, jax.Array]], Tuple[State, Metrics]]:
-    """Build the fused train step.  Strategy = where you place the inputs."""
+def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args
+                     ) -> Callable[[State, Dict[str, jax.Array]], Tuple[State, Metrics]]:
+    """The *unjitted* fused train step — callers choose how to compile it
+    (plain ``jit``, ``jit`` with mesh shardings, or inside ``shard_map``)."""
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
@@ -88,11 +89,18 @@ def make_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args
         wsum = jnp.maximum(batch["example_weight"].sum(), 1.0)
         return new_state, {"loss": loss, "accuracy": correct / wsum}
 
-    return jax.jit(train_step, donate_argnums=0)
+    return train_step
 
 
-def make_eval_step(cfg: BertConfig, args) -> Callable[..., Metrics]:
-    """Deterministic eval step returning global sums (host accumulates).
+def make_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args
+                    ) -> Callable[[State, Dict[str, jax.Array]], Tuple[State, Metrics]]:
+    """Build the fused train step.  Strategy = where you place the inputs."""
+    return jax.jit(build_train_step(cfg, tx, args), donate_argnums=0)
+
+
+def build_eval_step(cfg: BertConfig, args) -> Callable[..., Metrics]:
+    """Unjitted deterministic eval step returning global sums (host
+    accumulates).
 
     The reference's ``dev``/``test`` all-gather logits+labels across ranks
     (``multi-gpu-distributed-cls.py:145-155``); with a batch sharded over the
@@ -112,6 +120,17 @@ def make_eval_step(cfg: BertConfig, args) -> Callable[..., Metrics]:
             "weight": w.sum(),
             "correct": correct,
             "pred": jnp.argmax(logits, -1),
+            # echo labels/weights through the device: with a sharded batch and
+            # replicated outputs this is the all-gather that lets every host
+            # assemble the full (pred, label) stream for the report
+            # (multi-gpu-distributed-cls.py:145-155).
+            "label": batch["label"],
+            "ew": w,
         }
 
-    return jax.jit(eval_step)
+    return eval_step
+
+
+def make_eval_step(cfg: BertConfig, args) -> Callable[..., Metrics]:
+    """Jitted eval step (single-device / auto-propagated sharding)."""
+    return jax.jit(build_eval_step(cfg, args))

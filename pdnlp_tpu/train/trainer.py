@@ -55,6 +55,7 @@ class Trainer:
         total_step = len(train_loader) * args.epochs
         gstep = 0
         pending: Tuple[int, int, jax.Array] | None = None  # (epoch, gstep, loss)
+        metrics = None
         start = time.time()
         for epoch in range(1, args.epochs + 1):
             train_loader.set_epoch(epoch - 1)
@@ -71,6 +72,13 @@ class Trainer:
         if pending is not None:
             e, s, l = pending
             rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
+        # True completion barrier: fetch a VALUE from the last enqueued
+        # program.  Device programs execute in order, so the fetch cannot
+        # return before every prior step has run.  block_until_ready alone
+        # is not trustworthy on async-RPC device tunnels (observed on the
+        # 'axon' TPU platform: it returns at enqueue, not completion).
+        if metrics is not None:
+            float(jax.device_get(metrics["loss"]))
         jax.block_until_ready(self.state["params"])
         minutes = (time.time() - start) / 60
         rank0_print(fmt_elapsed_minutes(minutes))
@@ -87,8 +95,8 @@ class Trainer:
             rank0_print(fmt_best(acc))
 
     def _save(self, path: str) -> None:
-        if jax.process_index() == 0:
-            ckpt.save_params(path, self.state)
+        # all processes enter (consolidate is collective); rank 0 writes
+        ckpt.save_params(path, self.state)
 
     # ------------------------------------------------------------------- eval
     def _evaluate(self, loader, collect_preds: bool) -> Dict:
@@ -100,9 +108,9 @@ class Trainer:
             weight += float(m["weight"])
             correct += float(m["correct"])
             if collect_preds:
-                real = np.asarray(batch["example_weight"]) > 0  # drop filler rows
+                real = np.asarray(m["ew"]) > 0  # drop filler rows
                 y_pred.extend(np.asarray(m["pred"])[real].tolist())
-                y_true.extend(np.asarray(batch["label"])[real].tolist())
+                y_true.extend(np.asarray(m["label"])[real].tolist())
         weight = max(weight, 1.0)
         return {"loss": loss_sum / weight, "accuracy": correct / weight,
                 "y_true": y_true, "y_pred": y_pred}

@@ -34,6 +34,7 @@ class Args:
     model: str = "bert-base"                      # key into models.config registry
     num_labels: int = 6
     dropout: float = 0.1
+    attn_dropout: float = 0.1                     # attention_probs_dropout_prob
 
     # --- optimization (single-gpu-cls.py:86-97,193-205) ---
     learning_rate: float = 3e-5
@@ -48,7 +49,7 @@ class Args:
     eval_step: int = 50                           # multi-gpu-distributed-cls.py:252
     dev: bool = False                             # eval during training (default off)
     output_dir: str = "output"
-    ckpt_name: str = "model.msgpack"
+    ckpt_name: Optional[str] = None               # default: "<strategy>-cls.msgpack"
 
     # --- TPU-native knobs (replace AMP / ZeRO / launcher flags) ---
     dtype: str = "float32"                        # "bfloat16" = the AMP analog
@@ -79,7 +80,10 @@ class Args:
         return cls(**{k: v for k, v in d.items() if k in known})
 
     def ckpt_path(self, name: Optional[str] = None) -> str:
-        return os.path.join(self.output_dir, name or self.ckpt_name)
+        """One checkpoint per strategy, like the reference's per-script
+        ``*.pt`` files that ``test.py:85-94`` sweeps."""
+        return os.path.join(self.output_dir,
+                            name or self.ckpt_name or f"{self.strategy}-cls.msgpack")
 
 
 def parse_cli(argv=None, base: Optional[Args] = None) -> Args:

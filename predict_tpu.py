@@ -1,0 +1,78 @@
+"""Single-text inference sweep — the ``predict.py`` analog.
+
+Capability twin of ``/root/reference/predict.py:104-136,155-174``: sample a
+dev example whose true label is 厌恶/disgust (id 3, like the reference's
+sampling loop at ``:155-159``), then run it through every strategy
+checkpoint and print ``预测`` (predicted) vs ``真实`` (true) for each — the
+cross-strategy consistency smoke test.
+
+    python predict_tpu.py [--output_dir output] [--text "自定义文本"]
+"""
+from __future__ import annotations
+
+import os
+import random
+
+import jax
+import numpy as np
+
+from pdnlp_tpu.data import Collator, WordPieceTokenizer
+from pdnlp_tpu.data.corpus import id2label, load_data, split_data
+from pdnlp_tpu.data.tokenizer import get_or_build_vocab
+from pdnlp_tpu.models import bert
+from pdnlp_tpu.train import checkpoint as ckpt
+from pdnlp_tpu.train import setup_model
+from pdnlp_tpu.train.precision import resolve_dtype
+from pdnlp_tpu.utils.config import Args, parse_cli
+from pdnlp_tpu.utils.logging import rank0_print
+from test_tpu import discover_checkpoints
+
+
+def pick_sample(args: Args, want_label: int = 3):
+    """A dev example with the wanted label (predict.py:155-159's loop)."""
+    _, dev = split_data(load_data(args.data_path), seed=args.seed,
+                        limit=args.data_limit, ratio=args.ratio)
+    rng = random.Random(args.seed)
+    candidates = [ex for ex in dev if ex[1] == want_label]
+    return rng.choice(candidates) if candidates else rng.choice(dev)
+
+
+def main(args: Args, text=None, true_label=None):
+    tok = WordPieceTokenizer(get_or_build_vocab(args))
+    if text is None:
+        text, true_label = pick_sample(args)
+    rank0_print(f"文本：{text}")
+    enc = tok.encode_batch([text], args.max_seq_len)
+    batch = {k: v for k, v in enc.items()}
+
+    cfg, _, state = setup_model(args, tok.vocab_size)
+    dtype = resolve_dtype(args.dtype)
+
+    @jax.jit
+    def forward(params, batch):
+        return bert.classify(params, cfg, batch, dtype=dtype, deterministic=True)
+
+    preds = {}
+    for path in discover_checkpoints(args.output_dir):
+        name = os.path.basename(path)
+        params = jax.device_put(ckpt.load_params(path, state["params"]))
+        pred = int(np.argmax(np.asarray(forward(params, batch)[0])))
+        preds[name] = pred
+        true_s = id2label.get(true_label, "?") if true_label is not None else "?"
+        rank0_print(f"{name}  预测：{id2label[pred]}  真实：{true_s}")
+    if not preds:
+        rank0_print(f"no checkpoints under {args.output_dir}/")
+    return preds
+
+
+if __name__ == "__main__":
+    import sys
+
+    # --text is a sweep-local flag, not an Args field
+    argv = sys.argv[1:]
+    text = None
+    if "--text" in argv:
+        i = argv.index("--text")
+        text = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    main(parse_cli(argv, base=Args()), text=text)

@@ -1,0 +1,105 @@
+"""Convenience-API tests: Accelerator (prepare) and AutoTrainer (declarative),
+plus the offline sweep helpers — strategies 8/9 of the capability matrix and
+the ``test.py``/``predict.py`` analogs."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from pdnlp_tpu.train.accel import Accelerator
+from pdnlp_tpu.train.auto import AutoTrainer, TrainerArgs
+from pdnlp_tpu.utils.config import Args
+
+from tests.test_parallel import VOCAB, fake_batch, tiny_args
+
+
+def test_accelerator_prepare_and_step(ndev, tmp_path):
+    """User-written single-device pieces run distributed after prepare():
+    state lands on the mesh, loaders yield global arrays, and the compiled
+    step matches the framework's own DP step."""
+    from pdnlp_tpu.parallel import (
+        make_global_batch, make_mesh, make_parallel_train_step,
+        setup_sharded_model,
+    )
+    from pdnlp_tpu.train.setup import setup_model
+    from pdnlp_tpu.train.steps import build_eval_step, build_train_step
+
+    args = tiny_args()
+    batch = fake_batch(32)
+
+    acc = Accelerator()
+    assert acc.num_devices == ndev
+    cfg, tx, state = setup_model(args, VOCAB)
+    (state,) = acc.prepare(state)
+    step = acc.compile_step(build_train_step(cfg, tx, args))
+    state, m = step(state, acc.put(batch))
+
+    mesh = make_mesh()
+    cfg2, tx2, ref_state, sh = setup_sharded_model(args, VOCAB, mesh, "dp")
+    ref_step = make_parallel_train_step(cfg2, tx2, args, mesh, sh)
+    _, ref_m = ref_step(ref_state, make_global_batch(mesh)(batch))
+    assert float(m["loss"]) == pytest.approx(float(ref_m["loss"]), rel=1e-5)
+
+    ev = acc.compile_eval(build_eval_step(cfg, args))
+    em = acc.gather(ev(state["params"], acc.put(batch)))
+    assert em["pred"].shape == (32,)
+
+
+def test_accelerator_prepare_rescales_loader(corpus_path, ndev):
+    """prepare() scales the loader to the global batch — the auto-sharded
+    DataLoader that shrinks total_step (multi-gpu-accelerate-cls.py:145)."""
+    from pdnlp_tpu.train.setup import setup_data
+
+    args = Args(data_path=corpus_path, data_limit=600, max_seq_len=16,
+                vocab_path="output/test_vocab_conv.txt")
+    train_loader, _, _ = setup_data(args)
+    single_steps = len(train_loader)
+    acc = Accelerator()
+    cfg_state = {"params": {"w": np.zeros((4,), np.float32)}}
+    _, prepared = acc.prepare(cfg_state, train_loader)
+    assert len(prepared) == -(-single_steps * 32 // (32 * ndev))
+    b = next(iter(prepared))
+    assert b["input_ids"].shape[0] == 32 * ndev  # global batch, sharded
+    assert isinstance(b["input_ids"], jax.Array)
+
+
+def test_autotrainer_declarative_run(corpus_path, tmp_path):
+    """Declarative config drives a managed run: eval cadence, checkpoint
+    rotation, best-model reload (multi-gpu-transformers-cls.py:150-184)."""
+    targs = TrainerArgs(
+        output_dir=str(tmp_path / "auto"),
+        model="bert-tiny",
+        data_path=corpus_path,
+        data_limit=400,
+        max_seq_len=16,
+        eval_steps=1,
+        save_steps=1,
+        save_total_limit=1,
+        logging_steps=10 ** 6,
+        num_train_epochs=1,
+    )
+    # tiny vocab for the synthetic corpus
+    at = AutoTrainer(targs)
+    train_metrics = at.train()
+    assert train_metrics["global_step"] == len(at.train_loader)
+    assert train_metrics["train_runtime"] > 0
+    eval_metrics = at.evaluate()
+    assert 0.0 <= eval_metrics["eval_accuracy"] <= 1.0
+    # the best checkpoint survived rotation and was reloaded
+    assert at.best_ckpt is not None and os.path.isdir(at.best_ckpt)
+
+
+def test_sweep_discovers_and_validates_checkpoints(tmp_path):
+    """test_tpu sweep skips incompatible checkpoints instead of crashing
+    (shape validation lives in checkpoint.load)."""
+    from pdnlp_tpu.train import checkpoint as ckpt
+
+    good = {"a": np.ones((2, 3), np.float32)}
+    ckpt.save(str(tmp_path / "x-cls.msgpack"), good)
+    with pytest.raises(ValueError, match="does not match"):
+        ckpt.load(str(tmp_path / "x-cls.msgpack"),
+                  {"a": np.ones((4, 5), np.float32)})
+    back = ckpt.load(str(tmp_path / "x-cls.msgpack"), good)
+    np.testing.assert_array_equal(back["a"], good["a"])
