@@ -1,0 +1,280 @@
+// C++ WordPiece tokenizer — the hot path of the data pipeline.
+//
+// Mirrors pdnlp_tpu/data/tokenizer.py bit-for-bit (parity enforced by
+// tests/test_native_tokenizer.py over the real corpus).  The reference
+// framework leans on HF's native tokenizers for this; here the native piece
+// is owned: basic tokenization (lowercase, control-strip, whitespace split,
+// CJK/punct isolation) + greedy longest-match WordPiece, exposed through a
+// C ABI for ctypes (no pybind11 in this image).  ctypes releases the GIL
+// for the duration of wp_encode_batch, so the loader's prefetch thread
+// tokenizes truly in parallel with device compute.
+//
+// Unicode predicates come from tables.h, GENERATED from Python's
+// unicodedata (csrc/gen_tables.py) so the two implementations cannot drift.
+//
+// Build:  make -C csrc     (produces libwordpiece.so)
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "tables.h"
+
+namespace {
+
+bool in_ranges(const uint32_t (*ranges)[2], int n, uint32_t cp) {
+  int lo = 0, hi = n - 1;
+  while (lo <= hi) {
+    int mid = (lo + hi) / 2;
+    if (cp < ranges[mid][0]) hi = mid - 1;
+    else if (cp > ranges[mid][1]) lo = mid + 1;
+    else return true;
+  }
+  return false;
+}
+
+bool is_space(uint32_t cp) { return in_ranges(SPACE_RANGES, SPACE_RANGES_n, cp); }
+bool is_control(uint32_t cp) { return in_ranges(CONTROL_RANGES, CONTROL_RANGES_n, cp); }
+
+bool is_cjk(uint32_t cp) {
+  return (cp >= 0x4E00 && cp <= 0x9FFF) || (cp >= 0x3400 && cp <= 0x4DBF) ||
+         (cp >= 0x20000 && cp <= 0x2A6DF) || (cp >= 0x2A700 && cp <= 0x2B73F) ||
+         (cp >= 0x2B740 && cp <= 0x2B81F) || (cp >= 0x2B820 && cp <= 0x2CEAF) ||
+         (cp >= 0xF900 && cp <= 0xFAFF) || (cp >= 0x2F800 && cp <= 0x2FA1F);
+}
+
+bool is_punct(uint32_t cp) {
+  // ASCII symbol ranges treated as punctuation by BERT's basic tokenizer
+  if ((cp >= 33 && cp <= 47) || (cp >= 58 && cp <= 64) ||
+      (cp >= 91 && cp <= 96) || (cp >= 123 && cp <= 126))
+    return true;
+  return in_ranges(PUNCT_CAT_RANGES, PUNCT_CAT_RANGES_n, cp);
+}
+
+bool is_cased(uint32_t cp) { return in_ranges(CASED_RANGES, CASED_RANGES_n, cp); }
+bool is_case_ignorable(uint32_t cp) {
+  return in_ranges(CASE_IGNORABLE_RANGES, CASE_IGNORABLE_RANGES_n, cp);
+}
+
+// Unicode Final_Sigma: Σ at position i lowers to ς iff a cased char precedes
+// (skipping case-ignorables) and no cased char follows (ditto) — matching
+// Python's context-sensitive str.lower().
+bool final_sigma(const std::vector<uint32_t>& cps, size_t i) {
+  bool before = false;
+  for (size_t j = i; j-- > 0;) {
+    if (is_case_ignorable(cps[j])) continue;
+    before = is_cased(cps[j]);
+    break;
+  }
+  if (!before) return false;
+  for (size_t j = i + 1; j < cps.size(); ++j) {
+    if (is_case_ignorable(cps[j])) continue;
+    return !is_cased(cps[j]);
+  }
+  return true;
+}
+
+// str.lower() analog; appends the lowered codepoint(s) to out.
+void lower_cp(uint32_t cp, std::vector<uint32_t>* out) {
+  int lo = 0, hi = LOWER_MAP_n - 1;
+  while (lo <= hi) {
+    int mid = (lo + hi) / 2;
+    if (cp < LOWER_MAP[mid][0]) hi = mid - 1;
+    else if (cp > LOWER_MAP[mid][0]) lo = mid + 1;
+    else { out->push_back(LOWER_MAP[mid][1]); return; }
+  }
+  for (int i = 0; i < LOWER_MULTI_n; ++i) {
+    if (LOWER_MULTI[i][0] == cp) {
+      for (int j = 1; j < 4 && LOWER_MULTI[i][j]; ++j)
+        out->push_back(LOWER_MULTI[i][j]);
+      return;
+    }
+  }
+  out->push_back(cp);
+}
+
+// UTF-8 decode (invalid bytes -> U+FFFD, which the tokenizer drops,
+// matching Python semantics for the cp==0xFFFD check).
+std::vector<uint32_t> decode_utf8(const char* s, int64_t len) {
+  std::vector<uint32_t> cps;
+  cps.reserve(len);
+  int64_t i = 0;
+  while (i < len) {
+    uint8_t b = s[i];
+    uint32_t cp;
+    int n;
+    if (b < 0x80) { cp = b; n = 1; }
+    else if ((b >> 5) == 0x6) { cp = b & 0x1F; n = 2; }
+    else if ((b >> 4) == 0xE) { cp = b & 0x0F; n = 3; }
+    else if ((b >> 3) == 0x1E) { cp = b & 0x07; n = 4; }
+    else { cps.push_back(0xFFFD); ++i; continue; }
+    if (i + n > len) { cps.push_back(0xFFFD); break; }
+    bool ok = true;
+    for (int j = 1; j < n; ++j) {
+      uint8_t c = s[i + j];
+      if ((c >> 6) != 0x2) { ok = false; break; }
+      cp = (cp << 6) | (c & 0x3F);
+    }
+    if (!ok) { cps.push_back(0xFFFD); ++i; continue; }
+    cps.push_back(cp);
+    i += n;
+  }
+  return cps;
+}
+
+void encode_utf8(uint32_t cp, std::string* out) {
+  if (cp < 0x80) out->push_back((char)cp);
+  else if (cp < 0x800) {
+    out->push_back((char)(0xC0 | (cp >> 6)));
+    out->push_back((char)(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out->push_back((char)(0xE0 | (cp >> 12)));
+    out->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back((char)(0x80 | (cp & 0x3F)));
+  } else {
+    out->push_back((char)(0xF0 | (cp >> 18)));
+    out->push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+    out->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+    out->push_back((char)(0x80 | (cp & 0x3F)));
+  }
+}
+
+struct Tokenizer {
+  std::unordered_map<std::string, int32_t> vocab;
+  int32_t pad_id = 0, unk_id = 1, cls_id = 2, sep_id = 3;
+  static constexpr int kMaxChars = 100;  // wordpiece() max_chars
+
+  // basic_tokenize: lowercase, drop controls, split space, isolate CJK/punct
+  std::vector<std::string> basic_tokenize(const char* text, int64_t len) const {
+    std::vector<uint32_t> raw = decode_utf8(text, len);
+    std::vector<uint32_t> lowered;
+    lowered.reserve(raw.size());
+    for (size_t i = 0; i < raw.size(); ++i) {
+      if (raw[i] == 0x3A3)  // Σ: context-sensitive (Final_Sigma)
+        lowered.push_back(final_sigma(raw, i) ? 0x3C2 : 0x3C3);
+      else
+        lower_cp(raw[i], &lowered);
+    }
+    std::vector<std::string> out;
+    std::string buf;
+    for (uint32_t cp : lowered) {
+      if (cp == 0 || cp == 0xFFFD || is_control(cp)) continue;
+      if (is_space(cp)) {
+        if (!buf.empty()) { out.push_back(buf); buf.clear(); }
+      } else if (is_cjk(cp) || is_punct(cp)) {
+        if (!buf.empty()) { out.push_back(buf); buf.clear(); }
+        std::string one;
+        encode_utf8(cp, &one);
+        out.push_back(one);
+      } else {
+        encode_utf8(cp, &buf);
+      }
+    }
+    if (!buf.empty()) out.push_back(buf);
+    return out;
+  }
+
+  // greedy longest-match-first; whole-token UNK on failure
+  void wordpiece(const std::string& token, std::vector<int32_t>* ids) const {
+    std::vector<uint32_t> cps = decode_utf8(token.data(), token.size());
+    if ((int)cps.size() > kMaxChars) { ids->push_back(unk_id); return; }
+    // byte offsets of each codepoint boundary
+    std::vector<size_t> bounds{0};
+    {
+      std::string tmp;
+      for (uint32_t cp : cps) { encode_utf8(cp, &tmp); bounds.push_back(tmp.size()); }
+    }
+    std::vector<int32_t> pieces;
+    size_t start = 0;
+    while (start < cps.size()) {
+      size_t end = cps.size();
+      int32_t cur = -1;
+      while (start < end) {
+        std::string sub = token.substr(bounds[start], bounds[end] - bounds[start]);
+        if (start > 0) sub = "##" + sub;
+        auto it = vocab.find(sub);
+        if (it != vocab.end()) { cur = it->second; break; }
+        --end;
+      }
+      if (cur < 0) { ids->push_back(unk_id); return; }
+      pieces.push_back(cur);
+      start = end;
+    }
+    ids->insert(ids->end(), pieces.begin(), pieces.end());
+  }
+
+  void encode(const char* text, int64_t len, int max_len,
+              int32_t* input_ids, int32_t* attention_mask) const {
+    if (max_len < 2) {  // no room for [CLS]/[SEP]; binding validates too
+      for (int i = 0; i < max_len; ++i) { input_ids[i] = pad_id; attention_mask[i] = 0; }
+      return;
+    }
+    std::vector<int32_t> ids;
+    for (const std::string& tok : basic_tokenize(text, len)) wordpiece(tok, &ids);
+    if ((int)ids.size() > max_len - 2) ids.resize(max_len - 2);
+    int n = 0;
+    input_ids[n++] = cls_id;
+    for (int32_t id : ids) input_ids[n++] = id;
+    input_ids[n++] = sep_id;
+    for (int i = 0; i < n; ++i) attention_mask[i] = 1;
+    for (int i = n; i < max_len; ++i) { input_ids[i] = pad_id; attention_mask[i] = 0; }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// vocab_buf: newline-separated tokens in id order (the vocab.txt format).
+void* wp_create(const char* vocab_buf, int64_t len) {
+  auto* t = new Tokenizer();
+  int32_t id = 0;
+  const char* p = vocab_buf;
+  const char* endp = vocab_buf + len;
+  while (p < endp) {
+    const char* nl = (const char*)memchr(p, '\n', endp - p);
+    size_t n = nl ? (size_t)(nl - p) : (size_t)(endp - p);
+    if (n > 0) {
+      std::string tok(p, n);
+      t->vocab.emplace(std::move(tok), id);
+      ++id;
+    }
+    p += n + 1;
+  }
+  auto find = [&](const char* s) {
+    auto it = t->vocab.find(s);
+    return it == t->vocab.end() ? -1 : it->second;
+  };
+  t->pad_id = find("[PAD]");
+  t->unk_id = find("[UNK]");
+  t->cls_id = find("[CLS]");
+  t->sep_id = find("[SEP]");
+  if (t->pad_id < 0 || t->unk_id < 0 || t->cls_id < 0 || t->sep_id < 0) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+void wp_destroy(void* h) { delete static_cast<Tokenizer*>(h); }
+
+int32_t wp_vocab_size(void* h) {
+  return (int32_t)static_cast<Tokenizer*>(h)->vocab.size();
+}
+
+// texts_buf: concatenated UTF-8; offsets[i]..offsets[i+1] bounds text i.
+// input_ids / attention_mask: caller-allocated [n, max_len] int32, C-order.
+void wp_encode_batch(void* h, const char* texts_buf, const int64_t* offsets,
+                     int32_t n, int32_t max_len,
+                     int32_t* input_ids, int32_t* attention_mask) {
+  auto* t = static_cast<Tokenizer*>(h);
+  for (int32_t i = 0; i < n; ++i) {
+    t->encode(texts_buf + offsets[i], offsets[i + 1] - offsets[i], max_len,
+              input_ids + (int64_t)i * max_len,
+              attention_mask + (int64_t)i * max_len);
+  }
+}
+
+}  // extern "C"

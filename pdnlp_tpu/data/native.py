@@ -1,0 +1,108 @@
+"""ctypes binding for the C++ WordPiece tokenizer (``csrc/wordpiece.cpp``).
+
+The reference outsources its native tokenization to HF's compiled
+tokenizers (``/root/reference/single-gpu-cls.py:221`` — ``BertTokenizer``
+backed by native code in the fast path); this framework owns the native
+piece.  ctypes releases the GIL during ``wp_encode_batch``, so the data
+loader's prefetch thread tokenizes concurrently with device compute — the
+reason the loader is thread- not process-based (``data/loader.py``).
+
+``attach(tokenizer)`` is opportunistic: it binds the shared library if it
+has been built (``make -C csrc`` or ``build()``), else leaves the pure-
+Python path in place.  Both implementations are bit-identical (generated
+Unicode tables + ``tests/test_native_tokenizer.py`` corpus parity).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "libwordpiece.so")
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile the shared library (requires g++); returns its path or None."""
+    if force:
+        subprocess.run(["make", "-C", _CSRC, "clean"], capture_output=True)
+    r = subprocess.run(["make", "-C", _CSRC], capture_output=True, text=True)
+    if r.returncode != 0:
+        return None
+    return _SO if os.path.exists(_SO) else None
+
+
+class NativeEncoder:
+    """Wraps one ``wp_create`` handle; mirrors ``encode_batch``'s contract."""
+
+    def __init__(self, vocab: Sequence[str], so_path: str = _SO):
+        self._lib = ctypes.CDLL(so_path)
+        self._lib.wp_create.restype = ctypes.c_void_p
+        self._lib.wp_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        self._lib.wp_destroy.argtypes = [ctypes.c_void_p]
+        self._lib.wp_vocab_size.restype = ctypes.c_int32
+        self._lib.wp_vocab_size.argtypes = [ctypes.c_void_p]
+        self._lib.wp_encode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int32, ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        buf = ("\n".join(vocab) + "\n").encode("utf-8")
+        self._handle = self._lib.wp_create(buf, len(buf))
+        if not self._handle:
+            raise ValueError("vocab is missing required special tokens")
+        native_n = self._lib.wp_vocab_size(self._handle)
+        if native_n != len(vocab):
+            raise ValueError(
+                f"vocab has {len(vocab) - native_n} duplicate tokens — native "
+                "and Python id assignment would disagree")
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.wp_destroy(self._handle)
+            self._handle = None
+
+    def encode_batch(self, texts: Sequence[str], max_len: int = 128
+                     ) -> Dict[str, np.ndarray]:
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2 ([CLS]+[SEP]), got {max_len}")
+        n = len(texts)
+        raw = [t.encode("utf-8") for t in texts]
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in raw], out=offsets[1:])
+        blob = b"".join(raw)
+        input_ids = np.zeros((n, max_len), dtype=np.int32)
+        attention_mask = np.zeros((n, max_len), dtype=np.int32)
+        self._lib.wp_encode_batch(self._handle, blob, offsets, n, max_len,
+                                  input_ids, attention_mask)
+        return {
+            "input_ids": input_ids,
+            "attention_mask": attention_mask,
+            "token_type_ids": np.zeros((n, max_len), dtype=np.int32),
+        }
+
+
+def attach(tokenizer, so_path: str = _SO) -> bool:
+    """Bind the native encoder to a ``WordPieceTokenizer`` if the library is
+    built; returns True on success (tokenizer.encode_batch now native)."""
+    if not os.path.exists(so_path):
+        return False
+    try:
+        tokenizer._native = NativeEncoder(tokenizer.vocab_list, so_path)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+if __name__ == "__main__":
+    import sys
+
+    path = build(force="--force" in sys.argv)
+    print(f"built: {path}" if path else "build failed (is g++ available?)")
+    sys.exit(0 if path else 1)

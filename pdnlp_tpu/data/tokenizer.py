@@ -173,6 +173,8 @@ class WordPieceTokenizer:
         return pieces
 
     def encode(self, text: str, max_len: int = 128) -> Tuple[List[int], List[int], List[int]]:
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2 ([CLS]+[SEP]), got {max_len}")
         ids = [self.vocab.get(p, self.unk_id) for p in self.tokenize(text)]
         ids = ids[: max_len - 2]
         ids = [self.cls_id] + ids + [self.sep_id]

@@ -32,6 +32,9 @@ def setup_data(args, *, num_shards: int = 1, shard_id: int = 0,
     data = load_data(args.data_path)
     train, dev = split_data(data, seed=args.seed, limit=args.data_limit, ratio=args.ratio)
     tok = WordPieceTokenizer(get_or_build_vocab(args))
+    from pdnlp_tpu.data import native
+
+    native.attach(tok)  # no-op unless `make -C csrc` has been run
     col = Collator(tok, args.max_seq_len)
     train_loader = DataLoader(
         train, col, args.train_batch_size * device_batch_mult,

@@ -1,0 +1,128 @@
+"""C++ tokenizer parity: the native encoder must agree bit-for-bit with the
+Python reference implementation on the real corpus and on adversarial
+unicode, as ``data/tokenizer.py``'s module contract promises."""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from pdnlp_tpu.data import native
+from pdnlp_tpu.data.corpus import load_data
+from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
+
+
+@pytest.fixture(scope="module")
+def so_path():
+    path = native.build()
+    if path is None:
+        pytest.skip("g++/make unavailable — native tokenizer not built")
+    return path
+
+
+@pytest.fixture(scope="module")
+def corpus_texts(corpus_path):
+    return [t for t, _ in load_data(corpus_path)[:3000]]
+
+
+ADVERSARIAL = [
+    "",                                  # empty
+    "   ",                               # spaces only
+    "Hello, World! ABC-def",             # latin + ascii punct + case
+    "ＨＥＬＬＯ！，。；",                   # fullwidth latin (lower) + CJK punct
+    "İstanbul ß Straße",                 # 1->N lowering (İ -> i + U+0307)
+    "ΣΊΣΥΦΟΣ",                           # Greek: trailing Σ -> final sigma ς
+    "Σ",                                 # lone Σ -> σ (no cased context)
+    "ΑΣ ΒΣΓ Σ'Σ",                        # final vs medial sigma mixes
+    "中文混合English字符",                  # CJK/latin interleave
+    "​­zero​width",       # Cf controls stripped
+    "\t tab\nnewline　ideographic space",
+    "emoji😀mix中",                       # astral plane char
+    "𐐀𐐁 DESERET",                        # astral letters with lowercase forms
+    "\U000E0041tag\U000E007Fchars",      # astral Cf (tag) chars stripped
+    "x" * 300,                           # > max_chars whole-token UNK
+    "００１２３",                          # fullwidth digits
+]
+
+
+@pytest.fixture(scope="module")
+def tok_pair(so_path, corpus_texts):
+    # vocab covers the adversarial pieces too, so a divergence shows up as a
+    # different id — not as both sides collapsing to [UNK]
+    vocab = build_vocab(corpus_texts + [t.lower() for t in ADVERSARIAL])
+    py = WordPieceTokenizer(vocab)
+    nat = WordPieceTokenizer(vocab)
+    assert native.attach(nat, so_path)
+    return py, nat
+
+
+def assert_same(py, nat, texts, max_len=128):
+    a = py.encode_batch(texts, max_len)  # _native unset -> pure Python
+    b = nat._native.encode_batch(texts, max_len)
+    for k in ("input_ids", "attention_mask", "token_type_ids"):
+        np.testing.assert_array_equal(a[k], b[k], err_msg=f"{k} diverged")
+
+
+def test_corpus_parity(tok_pair, corpus_texts):
+    """Bit-identical encodings over 3k real corpus texts."""
+    py, nat = tok_pair
+    assert_same(py, nat, corpus_texts)
+
+
+def test_adversarial_unicode_parity(tok_pair):
+    py, nat = tok_pair
+    assert_same(py, nat, ADVERSARIAL, max_len=32)
+    # the sigma cases must not be [UNK]-collapses: verify real pieces emerge
+    ids = py.encode_batch(["ΣΊΣΥΦΟΣ"], 32)["input_ids"][0]
+    assert py.unk_id not in ids[1:int(sum(i != 0 for i in ids)) - 1]
+
+
+def test_max_len_guard(tok_pair):
+    py, nat = tok_pair
+    with pytest.raises(ValueError, match="max_len"):
+        py.encode_batch(["abc"], max_len=1)
+    with pytest.raises(ValueError, match="max_len"):
+        nat._native.encode_batch(["abc"], max_len=1)
+
+
+def test_duplicate_vocab_rejected(so_path):
+    from pdnlp_tpu.data.tokenizer import SPECIALS
+
+    with pytest.raises(ValueError, match="duplicate"):
+        native.NativeEncoder(SPECIALS + ["a", "a"], so_path)
+
+
+def test_truncation_and_padding_parity(tok_pair, corpus_texts):
+    py, nat = tok_pair
+    long_texts = [t for t in corpus_texts if len(t) > 40][:50]
+    assert_same(py, nat, long_texts, max_len=16)   # hard truncation
+    assert_same(py, nat, long_texts, max_len=256)  # heavy padding
+
+
+def test_loader_uses_native_when_built(so_path, corpus_path, tmp_path):
+    """setup_data attaches the native encoder transparently."""
+    from pdnlp_tpu.train.setup import setup_data
+    from pdnlp_tpu.utils.config import Args
+
+    args = Args(data_path=corpus_path, data_limit=200, max_seq_len=16,
+                vocab_path=str(tmp_path / "v.txt"))
+    train_loader, _, tok = setup_data(args)
+    assert tok._native is not None
+    batch = next(iter(train_loader))
+    assert batch["input_ids"].shape == (32, 16)
+
+
+def test_native_rejects_bad_vocab(so_path):
+    with pytest.raises(ValueError, match="special tokens"):
+        native.NativeEncoder(["a", "b", "c"], so_path)
+
+
+def test_native_speedup(tok_pair, corpus_texts):
+    """The point of the native path: meaningfully faster than pure Python."""
+    import time
+
+    py, nat = tok_pair
+    texts = corpus_texts[:1000]
+    t0 = time.perf_counter(); py.encode_batch(texts); t_py = time.perf_counter() - t0
+    t0 = time.perf_counter(); nat._native.encode_batch(texts); t_nat = time.perf_counter() - t0
+    assert t_nat < t_py, f"native ({t_nat:.3f}s) not faster than python ({t_py:.3f}s)"
