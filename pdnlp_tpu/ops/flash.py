@@ -1,0 +1,234 @@
+"""Flash attention — Pallas TPU kernel with full custom-VJP backward.
+
+The XLA path (``ops.attention``) materializes the [B, N, S, S] score tensor
+in HBM; at seq 128 XLA fuses it well, but the quadratic HBM traffic is what
+caps long-context training.  This kernel keeps scores in VMEM tiles and
+streams KV blocks through an online softmax (the FlashAttention recurrence),
+so HBM traffic stays linear in S:
+
+- **forward**: grid over (batch*heads, Q blocks); fori_loop over KV blocks
+  carrying (acc, rowmax m, rowsum l); saves the logsumexp rows L for the
+  backward pass.
+- **backward**: two independent kernels (no cross-grid accumulation):
+  dQ gridded over Q blocks, dK/dV gridded over KV blocks, both recomputing
+  probabilities from L — the standard FlashAttention-2 split.
+
+All matmuls run on the MXU with fp32 accumulation (``preferred_element_type``)
+regardless of the compute dtype.  Probability dropout is not implemented —
+``ops.attention`` routes training-with-attn-dropout to the XLA path.
+
+Capability note: the reference framework has no custom kernels (its native
+ops live in cuDNN/NCCL, ``SURVEY.md`` §2.4); this is the owned-TPU-kernel
+equivalent and the building block of the long-context path (``ops.ring``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_Q = 128
+BLOCK_K = 128
+NEG_INF = -1e9
+
+
+def _interpret() -> bool:
+    """Pallas TPU kernels run via the interpreter on non-TPU backends (CI's
+    virtual CPU mesh); compiled Mosaic on real chips."""
+    return jax.default_backend() != "tpu"
+
+
+def supported(q: jax.Array) -> bool:
+    """Static-shape gate used by ``ops.attention``: S must tile by 128."""
+    S = q.shape[1]
+    return S >= BLOCK_Q and S % BLOCK_Q == 0
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, l_ref, *, scale, s_len):
+    q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
+    nk = s_len // BLOCK_K
+
+    def body(ki, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(ki * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        b = bias_ref[0, 0, pl.ds(ki * BLOCK_K, BLOCK_K)].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s + b[None, :]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    acc0 = jnp.zeros((BLOCK_Q, q.shape[-1]), jnp.float32)
+    m0 = jnp.full((BLOCK_Q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BLOCK_Q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    l_ref[0, 0] = (m + jnp.log(l))[:, 0]              # logsumexp rows
+
+
+def _fwd(q3, k3, v3, bias2, scale):
+    """q3/k3/v3: [BN, S, D]; bias2: [BN, S] additive. -> (o3, L[BN, S])."""
+    BN, S, D = q3.shape
+    grid = (BN, S // BLOCK_Q)
+    kernel = functools.partial(_fwd_kernel, scale=scale, s_len=S)
+    o3, L = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BN, S, D), q3.dtype),
+            jax.ShapeDtypeStruct((BN, 1, S), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, bias2)
+    return o3, L
+
+
+# --------------------------------------------------------------- backward
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, L_ref, Di_ref, dq_ref,
+               *, scale):
+    q = q_ref[0].astype(jnp.float32)                   # [Bq, D]
+    k = k_ref[0].astype(jnp.float32)                   # [S, D]
+    v = v_ref[0].astype(jnp.float32)                   # [S, D]
+    do = do_ref[0].astype(jnp.float32)                 # [Bq, D]
+    L = L_ref[0, 0][:, None]                           # [Bq, 1]
+    Di = Di_ref[0, 0][:, None]                         # [Bq, 1]
+    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+    p = jnp.exp(s - L)                                 # [Bq, S]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - Di)
+    dq_ref[0] = (jnp.dot(ds, k, preferred_element_type=jnp.float32)
+                 * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, L_ref, Di_ref,
+                dk_ref, dv_ref, *, scale):
+    q = q_ref[0].astype(jnp.float32)                   # [S, D]
+    k = k_ref[0].astype(jnp.float32)                   # [Bk, D]
+    v = v_ref[0].astype(jnp.float32)                   # [Bk, D]
+    do = do_ref[0].astype(jnp.float32)                 # [S, D]
+    L = L_ref[0, 0][:, None]                           # [S, 1]
+    Di = Di_ref[0, 0][:, None]                         # [S, 1]
+    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]  # bias over this K blk
+    p = jnp.exp(s - L)                                 # [S, Bk]
+    dv_ref[0] = jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - Di)                                 # [S, Bk]
+    dk_ref[0] = (jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale).astype(dk_ref.dtype)
+
+
+def _bwd(scale, res, do3):
+    q3, k3, v3, bias2, o3, L = res
+    BN, S, D = q3.shape
+    Di = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)[:, None, :]
+
+    dq3 = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale),
+        grid=(BN, S // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BN, S, D), q3.dtype),
+        interpret=_interpret(),
+    )(q3, k3, v3, bias2, do3, L, Di)
+
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale),
+        grid=(BN, S // BLOCK_K),
+        in_specs=[
+            pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K), lambda bh, ki: (bh, 0, ki)),
+            pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BN, S, D), k3.dtype),
+            jax.ShapeDtypeStruct((BN, S, D), v3.dtype),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, bias2, do3, L, Di)
+    return dq3, dk3, dv3, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash3(q3, k3, v3, bias2, scale):
+    return _fwd(q3, k3, v3, bias2, scale)[0]
+
+
+def _flash3_fwd(q3, k3, v3, bias2, scale):
+    o3, L = _fwd(q3, k3, v3, bias2, scale)
+    return o3, (q3, k3, v3, bias2, o3, L)
+
+
+_flash3.defvjp(_flash3_fwd, _bwd)
+
+
+def flash_attention(
+    q: jax.Array,   # [B, S, N, D]
+    k: jax.Array,
+    v: jax.Array,
+    bias: Optional[jax.Array] = None,  # [B, 1, 1, S] additive (mask_bias)
+) -> jax.Array:
+    """Drop-in for the XLA path of ``ops.attention.dot_product_attention``
+    (same [B, S, N, D] layout, same additive-bias contract)."""
+    B, S, N, D = q.shape
+    scale = D ** -0.5
+
+    def to3(t):
+        return t.transpose(0, 2, 1, 3).reshape(B * N, S, D)
+
+    if bias is None:
+        bias2 = jnp.zeros((B * N, 1, S), jnp.float32)
+    else:
+        bias2 = jnp.broadcast_to(
+            bias.reshape(B, 1, S).astype(jnp.float32), (B, N, S)
+        ).reshape(B * N, 1, S)
+    o3 = _flash3(to3(q), to3(k), to3(v), bias2, scale)
+    return o3.reshape(B, N, S, D).transpose(0, 2, 1, 3)
