@@ -119,17 +119,29 @@ def encode(
     rng: Optional[jax.Array] = None,
     remat: bool = False,
     attn_impl: str = "xla",
+    seq_axis: Optional[str] = None,
 ) -> jax.Array:
-    """Run the encoder stack; returns hidden states [B, S, H] in ``dtype``."""
+    """Run the encoder stack; returns hidden states [B, S, H] in ``dtype``.
+
+    ``seq_axis``: name of a mesh axis the *sequence* dimension is sharded
+    over (must be inside ``shard_map``).  Position embeddings use global
+    positions (shard offset) and attention runs as ring attention over the
+    axis (``ops.ring``) — the long-context sequence-parallel path.
+    """
     B, S = input_ids.shape
-    if S > cfg.max_position:
+    shard_offset = 0
+    if seq_axis is not None:
+        shard_offset = jax.lax.axis_index(seq_axis) * S
+        if S * jax.lax.axis_size(seq_axis) > cfg.max_position:
+            raise ValueError("global sequence exceeds max_position")
+    elif S > cfg.max_position:
         raise ValueError(
             f"sequence length {S} exceeds max_position {cfg.max_position}; "
             "JAX gather would silently clamp position embeddings")
     emb = params["embeddings"]
     x = (
         emb["word"][input_ids]
-        + emb["position"][jnp.arange(S)][None, :, :]
+        + emb["position"][jnp.arange(S) + shard_offset]
         + emb["token_type"][token_type_ids]
     ).astype(dtype)
     x = _layer_norm(x, emb["ln"]["scale"], emb["ln"]["bias"], cfg.layer_norm_eps)
@@ -137,7 +149,12 @@ def encode(
         rng, k = jax.random.split(rng)
         x = _dropout(x, cfg.dropout, k)
 
-    bias = mask_bias(attention_mask, dtype)
+    if seq_axis is None:
+        bias = mask_bias(attention_mask, dtype)
+    else:
+        # same additive-mask semantics, squeezed to the [B, S_local] rows the
+        # ring rotates alongside KV
+        ring_bias = mask_bias(attention_mask, jnp.float32)[:, 0, 0, :]
     N, D = cfg.num_heads, cfg.head_dim
 
     def layer(carry, scanned):
@@ -150,11 +167,16 @@ def encode(
         q = heads(_dense(x, lp["q"], dtype))
         k = heads(_dense(x, lp["k"], dtype))
         v = heads(_dense(x, lp["v"], dtype))
-        attn = dot_product_attention(
-            q, k, v, bias, impl=attn_impl,
-            dropout_rate=0.0 if deterministic else cfg.attn_dropout,
-            dropout_rng=None if deterministic else jax.random.fold_in(rng, 3 * li + 2),
-        )
+        if seq_axis is not None:
+            from pdnlp_tpu.ops.ring import ring_attention
+
+            attn = ring_attention(q, k, v, ring_bias, axis_name=seq_axis)
+        else:
+            attn = dot_product_attention(
+                q, k, v, bias, impl=attn_impl,
+                dropout_rate=0.0 if deterministic else cfg.attn_dropout,
+                dropout_rng=None if deterministic else jax.random.fold_in(rng, 3 * li + 2),
+            )
         attn = _dense(attn.reshape(B, S, N * D), lp["o"], dtype)
         if not deterministic:
             attn = _dropout(attn, cfg.dropout, jax.random.fold_in(rng, 3 * li))
@@ -190,10 +212,16 @@ def classify(
     rng: Optional[jax.Array] = None,
     remat: bool = False,
     attn_impl: str = "xla",
+    seq_axis: Optional[str] = None,
 ) -> jax.Array:
     """Logits [B, num_labels] (fp32) — the ``model(**batch) -> logits`` twin
     of the reference's classification forward (``single-gpu-cls.py:119-124``:
-    pooled [CLS] -> dropout -> linear)."""
+    pooled [CLS] -> dropout -> linear).
+
+    Under ``seq_axis`` (sequence-parallel), the [CLS] position lives on
+    shard 0; a masked ``psum`` broadcasts it so every shard computes the
+    same logits (attention-probability dropout is skipped on this path —
+    ``ops.ring`` has no dropout)."""
     if not deterministic:
         rng, enc_rng, drop_rng = jax.random.split(rng, 3)
     else:
@@ -202,9 +230,13 @@ def classify(
         params, cfg,
         batch["input_ids"], batch["token_type_ids"], batch["attention_mask"],
         dtype=dtype, deterministic=deterministic, rng=enc_rng, remat=remat,
-        attn_impl=attn_impl,
+        attn_impl=attn_impl, seq_axis=seq_axis,
     )
-    pooled = jnp.tanh(_dense(hidden[:, 0, :], params["pooler"], dtype))
+    h0 = hidden[:, 0, :]
+    if seq_axis is not None:
+        on_shard0 = (jax.lax.axis_index(seq_axis) == 0).astype(h0.dtype)
+        h0 = jax.lax.psum(h0 * on_shard0, seq_axis)
+    pooled = jnp.tanh(_dense(h0, params["pooler"], dtype))
     if not deterministic:
         pooled = _dropout(pooled, cfg.dropout, drop_rng)
     logits = _dense(pooled, params["classifier"], dtype)

@@ -1,0 +1,82 @@
+"""Ring attention — sequence-parallel attention over a mesh ``seq`` axis.
+
+Long-context training shards the *sequence* across devices; attention then
+needs every Q shard to see every KV shard.  Ring attention does this with
+``axis_size`` steps of neighbor exchange: each device computes blockwise
+attention of its local Q against the KV block it currently holds, folds the
+result into an online-softmax accumulator (the same recurrence as the flash
+kernel), and passes the KV block to the next device with ``lax.ppermute``
+over the ICI ring.  Peak memory per device stays O(S_local) and the
+KV transfer overlaps with the block compute under XLA's scheduler.
+
+The reference framework has nothing comparable (max_seq_len fixed at 128,
+``SURVEY.md`` §5 "Long-context: absent") — this is a capability the TPU
+framework adds, designed mesh-first rather than ported.
+
+Use inside ``shard_map`` with the sequence dimension sharded over
+``axis_name`` (see ``parallel.sp`` for the full sequence-parallel encoder).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pdnlp_tpu.ops.attention import NEG_INF
+
+
+def _block_attn(q, k, v, bias):
+    """One blockwise partial attention: returns (numerator [B,Sq,N,D],
+    rowmax m, rowsum l) in fp32 — the merge state of the online softmax."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)[:, None, None, :]
+    m = jnp.max(s, axis=-1, keepdims=True)              # [B,N,Sq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    num = jnp.einsum("bnqk,bknd->bqnd", p, v.astype(jnp.float32))
+    return num, m, l
+
+
+def ring_attention(
+    q: jax.Array,                    # [B, S_local, N, D] — this shard's Q
+    k: jax.Array,                    # [B, S_local, N, D] — this shard's KV
+    v: jax.Array,
+    bias_local: Optional[jax.Array],  # [B, S_local] additive mask bias
+    axis_name: str = "seq",
+) -> jax.Array:
+    """Full-sequence attention for a sequence-sharded layout (must run
+    inside ``shard_map`` over ``axis_name``).  Output is this shard's rows,
+    exactly equal to single-device attention over the gathered sequence."""
+    n = lax.axis_size(axis_name)
+    if bias_local is None:
+        bias_local = jnp.zeros(q.shape[:2], jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        acc, m, l, kv = carry
+        # rotate first, so exactly n-1 permutes happen across the loop (the
+        # local block was consumed before the loop); the transfer overlaps
+        # with this step's compute under XLA scheduling
+        k_blk, v_blk, b_blk = jax.tree_util.tree_map(
+            lambda t: lax.ppermute(t, axis_name, perm), kv)
+        num, m_blk, l_blk = _block_attn(q, k_blk, v_blk, b_blk)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)                  # rescale old accumulator
+        beta = jnp.exp(m_blk - m_new)               # rescale new block
+        l = l * alpha + l_blk * beta
+        # acc holds [B,Sq,N,D]; alpha/beta are [B,N,Sq,1] -> move axes
+        acc = acc * alpha.transpose(0, 2, 1, 3) + num * beta.transpose(0, 2, 1, 3)
+        return acc, m_new, l, (k_blk, v_blk, b_blk)
+
+    # step 0: this shard's own KV block, no communication
+    acc, m, l = _block_attn(q, k, v, bias_local)
+    acc, m, l, _ = lax.fori_loop(
+        1, n, step, (acc, m, l, (k, v, bias_local)), unroll=True)
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)

@@ -1,0 +1,144 @@
+"""Sequence parallelism — training over a ``('data', 'seq')`` mesh.
+
+The long-context path: activations are sharded along the *sequence* inside
+each data shard, attention runs as ring attention (``ops.ring``), and the
+classifier head is computed from the psum-broadcast [CLS] vector.  The
+gradient-correctness subtlety is the redundant head compute: every seq
+shard produces identical logits, so the loss is *gated to seq-shard 0* —
+its backward broadcasts the pooled cotangent to all shards through the
+psum, each shard backpropagates exactly its own sequence slice, and the
+plain ``psum`` of gradients over ``seq`` counts head parameters once.
+
+This capability has no reference twin (``SURVEY.md`` §5: long-context
+"absent"); it exists so the framework scales past single-device sequence
+lengths, and is exercised by the multichip dryrun and the CPU-mesh tests.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pdnlp_tpu.models import BertConfig, bert
+from pdnlp_tpu.train.precision import resolve_dtype
+from pdnlp_tpu.train.steps import State, weighted_ce
+
+DATA, SEQ = "data", "seq"
+
+
+def make_sp_batch(mesh: Mesh) -> Callable[[Dict], Dict[str, jax.Array]]:
+    """Batch placement: token arrays [B, S] shard over (data, seq); label
+    vectors [B] shard over data only."""
+
+    def put(batch: Dict) -> Dict[str, jax.Array]:
+        out = {}
+        for key, val in batch.items():
+            spec = P(DATA, SEQ) if val.ndim == 2 else P(DATA)
+            out[key] = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), val)
+        return out
+
+    return put
+
+
+def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
+    """Fused sequence-parallel train step (state replicated, batch sharded
+    over (data, seq)); same Trainer contract as every other strategy."""
+    dtype = resolve_dtype(args.dtype)
+    remat = bool(args.remat)
+    if args.attn_dropout > 0:
+        raise ValueError(
+            "sequence-parallel training has no attention-probability dropout "
+            "(ops.ring does not implement it); pass --attn_dropout 0 "
+            "explicitly so runs stay comparable across strategies")
+
+    def local_loss(params, batch, rng):
+        logits = bert.classify(params, cfg, batch, dtype=dtype,
+                               deterministic=False, rng=rng, remat=remat,
+                               seq_axis=SEQ)
+        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"])
+        # gate to seq-shard 0: head grads counted once; encoder grads flow
+        # to every shard through the psum backward (see module docstring)
+        on0 = (jax.lax.axis_index(SEQ) == 0).astype(loss.dtype)
+        return loss * on0, (correct * on0, batch["example_weight"].sum() * on0)
+
+    def per_device(state: State, batch) -> Tuple[State, Dict[str, jax.Array]]:
+        rng = jax.random.fold_in(state["rng"], state["step"])
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA))
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(SEQ))
+        (loss, (correct, lw)), grads = jax.value_and_grad(
+            local_loss, has_aux=True)(state["params"], batch, rng)
+        # seq axis: plain sum (loss gated to one shard; each shard owns its
+        # slice of encoder grads).  data axis: weight-mass average, exactly
+        # as the explicit-collectives DP step.
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, SEQ), grads)
+        loss = jax.lax.psum(loss, SEQ)
+        correct = jax.lax.psum(correct, SEQ)
+        lw = jax.lax.psum(lw, SEQ)
+        gw = jax.lax.psum(lw, DATA)
+        scale = lw / gw
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g * scale, DATA), grads)
+        loss = jax.lax.psum(loss * scale, DATA)
+        acc = jax.lax.psum(correct, DATA) / gw
+        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {"params": params, "opt_state": opt_state,
+                     "step": state["step"] + 1, "rng": state["rng"]}
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    def specs_for(batch):
+        return {k: P(DATA, SEQ) if v.ndim == 2 else P(DATA)
+                for k, v in batch.items()}
+
+    def compile_step(example_batch):
+        mapped = jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(), specs_for(example_batch)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=0)
+
+    return compile_step
+
+
+def make_sp_eval_step(cfg: BertConfig, args, mesh: Mesh):
+    """Deterministic sequence-parallel eval step (same metric contract as
+    ``train.steps.build_eval_step``)."""
+    dtype = resolve_dtype(args.dtype)
+
+    def per_device(params, batch):
+        logits = bert.classify(params, cfg, batch, dtype=dtype,
+                               deterministic=True, seq_axis=SEQ)
+        w = batch["example_weight"]
+        loss, correct = weighted_ce(logits, batch["label"], w)
+        wsum = w.sum()
+        out = {
+            "loss_sum": jax.lax.psum(loss * wsum, DATA),
+            "weight": jax.lax.psum(wsum, DATA),
+            "correct": jax.lax.psum(correct, DATA),
+            "pred": jax.lax.all_gather(jnp.argmax(logits, -1), DATA, tiled=True),
+            "label": jax.lax.all_gather(batch["label"], DATA, tiled=True),
+            "ew": jax.lax.all_gather(w, DATA, tiled=True),
+        }
+        return out
+
+    def specs_for(batch):
+        return {k: P(DATA, SEQ) if v.ndim == 2 else P(DATA)
+                for k, v in batch.items()}
+
+    def compile_step(example_batch):
+        mapped = jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(), specs_for(example_batch)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
+
+    return compile_step
