@@ -28,6 +28,7 @@ from pdnlp_tpu.train import checkpoint as ckpt
 from pdnlp_tpu.utils.logging import (
     fmt_best, fmt_dev, fmt_elapsed_minutes, fmt_train, rank0_print,
 )
+from pdnlp_tpu.utils.profiling import Profiler, StepStats
 
 
 class Trainer:
@@ -56,12 +57,16 @@ class Trainer:
         gstep = 0
         pending: Tuple[int, int, jax.Array] | None = None  # (epoch, gstep, loss)
         metrics = None
+        profiler = Profiler(getattr(args, "profile_dir", None))
+        examples = 0
         start = time.time()
         for epoch in range(1, args.epochs + 1):
             train_loader.set_epoch(epoch - 1)
             for batch in train_loader:
                 self.state, metrics = self.train_step(self.state, self.put(batch))
                 gstep += 1
+                examples += int(batch["example_weight"].sum())
+                profiler.step(gstep)
                 if gstep % args.log_every == 0:
                     if pending is not None:  # print the *previous* step's loss:
                         e, s, l = pending     # it is done by now — no sync stall
@@ -80,8 +85,10 @@ class Trainer:
         if metrics is not None:
             float(jax.device_get(metrics["loss"]))
         jax.block_until_ready(self.state["params"])
+        profiler.close()
         minutes = (time.time() - start) / 60
         rank0_print(fmt_elapsed_minutes(minutes))
+        rank0_print(StepStats(gstep, examples, minutes).line())
         if not args.dev:
             self._save(args.ckpt_path())
         return minutes
@@ -97,6 +104,24 @@ class Trainer:
     def _save(self, path: str) -> None:
         # all processes enter (consolidate is collective); rank 0 writes
         ckpt.save_params(path, self.state)
+
+    # ---------------------------------------------------------------- resume
+    def save_resume(self, path: str) -> None:
+        """Full mid-training snapshot: params + optimizer moments + step +
+        RNG.  The reference cannot resume (``SURVEY.md`` §5: no optimizer
+        state saving anywhere); this framework can, bitwise."""
+        ckpt.save_state(path, self.state)
+
+    def load_resume(self, path: str) -> None:
+        restored = ckpt.load_state(path, self.state)
+        self.state = jax.device_put(restored, _shardings_of(self.state))
+
+
+def _shardings_of(state):
+    """Current sharding tree of a live state (resume re-places restored host
+    arrays exactly where the originals lived — replicated or ZeRO-sharded)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.sharding if isinstance(x, jax.Array) else None, state)
 
     # ------------------------------------------------------------------- eval
     def _evaluate(self, loader, collect_preds: bool) -> Dict:

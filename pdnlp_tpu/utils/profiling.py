@@ -1,0 +1,76 @@
+"""Profiling and step-rate observability.
+
+The reference's only timing is a wall-clock print around the epoch loop
+(``/root/reference/single-gpu-cls.py:129,150-151``) plus DeepSpeed's
+``wall_clock_breakdown`` (``multi-gpu-deepspeed-cls.py:245``).  Here:
+
+- ``Profiler`` wraps a window of training steps in a ``jax.profiler`` trace
+  (viewable in TensorBoard/XProf) when ``--profile_dir`` is set — device
+  timelines, HLO cost, HBM usage; the window skips warmup steps so the
+  trace shows steady state, not compilation.
+- ``StepStats`` turns the epoch wall-clock into the derived rates the
+  reference's README table reports informally (steps/s, examples/s).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from pdnlp_tpu.utils.logging import rank0_print
+
+
+class Profiler:
+    """Trace steps [start, start+steps) of training into ``profile_dir``."""
+
+    def __init__(self, profile_dir: Optional[str], start_step: int = 10,
+                 num_steps: int = 10):
+        self.dir = profile_dir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+
+    def step(self, gstep: int) -> None:
+        """Call once per training step with the global step index."""
+        if not self.dir:
+            return
+        if gstep == self.start_step and not self._active:
+            import jax
+
+            try:
+                jax.profiler.start_trace(self.dir)
+                self._active = True
+                rank0_print(f"[profiler] tracing steps {self.start_step}.."
+                            f"{self.stop_step} -> {self.dir}")
+            except Exception as e:  # platform without profiler support
+                rank0_print(f"[profiler] trace unavailable: {e}")
+                self.dir = None
+        elif gstep == self.stop_step and self._active:
+            self.close()
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+@dataclasses.dataclass
+class StepStats:
+    """Derived rates from the timed epoch (the north-star denominators)."""
+
+    steps: int
+    examples: int
+    minutes: float
+
+    @property
+    def steps_per_second(self) -> float:
+        return self.steps / (self.minutes * 60) if self.minutes else 0.0
+
+    @property
+    def examples_per_second(self) -> float:
+        return self.examples / (self.minutes * 60) if self.minutes else 0.0
+
+    def line(self) -> str:
+        return (f"steps/s：{self.steps_per_second:.2f}  "
+                f"samples/s：{self.examples_per_second:.1f}")

@@ -1,0 +1,106 @@
+"""Mid-training resume + profiling observability.
+
+The reference saves only model weights at epoch end and cannot resume
+mid-training (``SURVEY.md`` §5).  This framework checkpoints the full train
+state (params, Adam moments, step counter, RNG key); the acceptance bar is
+*bitwise* continuation: interrupt-and-resume must produce exactly the same
+state as an uninterrupted run.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from pdnlp_tpu.train.setup import setup_model
+from pdnlp_tpu.train.steps import make_train_step
+from pdnlp_tpu.train.trainer import Trainer
+from pdnlp_tpu.utils.config import Args
+
+from tests.test_parallel import VOCAB, fake_batch, tiny_args
+
+
+def run_steps(state, step_fn, batches):
+    for b in batches:
+        state, m = step_fn(state, b)
+    return state, m
+
+
+def test_resume_is_bitwise(tmp_path):
+    """2 steps + save + restore + 2 steps == 4 uninterrupted steps, with
+    dropout ON (the RNG key and step counter round-trip through the file)."""
+    args = tiny_args(dropout=0.1, attn_dropout=0.1)
+    batches = [fake_batch(8, seed=i) for i in range(4)]
+
+    cfg, tx, state = setup_model(args, VOCAB)
+    step = make_train_step(cfg, tx, args)
+    straight, _ = run_steps(state, step, batches)
+
+    cfg2, tx2, state2 = setup_model(args, VOCAB)
+    step2 = make_train_step(cfg2, tx2, args)
+    half, _ = run_steps(state2, step2, batches[:2])
+    t = Trainer(args, cfg2, half, step2, eval_step=None)
+    path = str(tmp_path / "resume.msgpack")
+    t.save_resume(path)
+
+    # fresh process analog: new state template, load, continue
+    cfg3, tx3, state3 = setup_model(args, VOCAB)
+    step3 = make_train_step(cfg3, tx3, args)
+    t3 = Trainer(args, cfg3, state3, step3, eval_step=None)
+    t3.load_resume(path)
+    assert int(t3.state["step"]) == 2
+    resumed, _ = run_steps(t3.state, step3, batches[2:])
+
+    for a, b in zip(jax.tree_util.tree_leaves(straight["params"]),
+                    jax.tree_util.tree_leaves(resumed["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(resumed["step"]) == 4
+
+
+def test_resume_preserves_sharding(tmp_path, ndev):
+    """A ZeRO-sharded state restores onto its original shardings."""
+    from pdnlp_tpu.parallel import (
+        make_global_batch, make_mesh, make_parallel_train_step,
+        setup_sharded_model, shard_fraction,
+    )
+
+    args = tiny_args()
+    mesh = make_mesh()
+    cfg, tx, state, sh = setup_sharded_model(args, VOCAB, mesh, "zero")
+    step = make_parallel_train_step(cfg, tx, args, mesh, sh)
+    put = make_global_batch(mesh)
+    state, _ = step(state, put(fake_batch(32)))
+
+    t = Trainer(args, cfg, state, step, eval_step=None)
+    path = str(tmp_path / "zero_resume.msgpack")
+    t.save_resume(path)
+    t.load_resume(path)
+    assert shard_fraction(t.state, mesh) < 1.5 / ndev  # still ZeRO-sharded
+    # and the restored state steps fine
+    t.state, m = step(t.state, put(fake_batch(32, seed=1)))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_profiler_writes_trace(tmp_path):
+    """--profile_dir produces a trace dump around the configured window."""
+    from pdnlp_tpu.utils.profiling import Profiler
+
+    d = str(tmp_path / "trace")
+    p = Profiler(d, start_step=1, num_steps=1)
+    x = jax.numpy.ones((128, 128))
+    p.step(1)
+    jax.block_until_ready(x @ x)
+    p.step(2)
+    p.close()
+    found = [f for _, _, fs in os.walk(d) for f in fs]
+    assert found, "no profiler artifacts written"
+
+
+def test_step_stats_rates():
+    from pdnlp_tpu.utils.profiling import StepStats
+
+    s = StepStats(steps=288, examples=9200, minutes=0.5)
+    assert s.steps_per_second == pytest.approx(9.6)
+    assert s.examples_per_second == pytest.approx(306.67, rel=1e-3)
+    assert "steps/s" in s.line()
