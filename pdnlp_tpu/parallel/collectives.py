@@ -60,14 +60,20 @@ def barrier() -> None:
         multihost_utils.sync_global_devices("pdnlp_tpu.barrier")
 
 
-def make_global_batch(mesh: Mesh, axis: str = DATA_AXIS
+def make_global_batch(mesh: Mesh, axis: str = DATA_AXIS,
+                      leading_stack: bool = False
                       ) -> Callable[[Dict], Dict[str, jax.Array]]:
     """Returns ``put(batch)``: host-local numpy batch -> global ``jax.Array``
     dict sharded along the data axis.  Single-process: the full batch is
     scattered over local devices.  Multi-process: each host contributes its
     shard (built by ``DistributedShardSampler``) and the global array spans
-    hosts — no gather ever materializes on one device."""
-    sharding = NamedSharding(mesh, P(axis))
+    hosts — no gather ever materializes on one device.
+
+    ``leading_stack=True`` is the fused-multi-step layout: arrays carry a
+    leading ``[K]`` step axis that stays unsharded; the batch axis (dim 1)
+    shards over ``data``."""
+    spec = P(None, axis) if leading_stack else P(axis)
+    sharding = NamedSharding(mesh, spec)
 
     def put(batch: Dict) -> Dict[str, jax.Array]:
         return {

@@ -70,6 +70,23 @@ def make_parallel_train_step(cfg: BertConfig, tx, args, mesh: Mesh, shardings):
     )
 
 
+def make_parallel_multi_step(cfg: BertConfig, tx, args, mesh: Mesh, shardings):
+    """K-step fused variant of ``make_parallel_train_step`` (batches carry a
+    leading unsharded ``[K]`` axis; batch dim shards over ``data``)."""
+    from jax.sharding import NamedSharding
+    from pdnlp_tpu.train.steps import build_multi_step
+
+    fn = build_multi_step(build_train_step(cfg, tx, args))
+    batch_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+    metrics_sh = replicated(mesh)
+    return jax.jit(
+        fn,
+        donate_argnums=0,
+        in_shardings=(shardings, batch_sh),
+        out_shardings=(shardings, metrics_sh),
+    )
+
+
 def make_parallel_eval_step(cfg: BertConfig, args, mesh: Mesh, param_shardings):
     """Eval step over the mesh; outputs replicated so every host can read
     them (the ``output_reduce`` all-gather, ``multi-gpu-distributed-cls.py:

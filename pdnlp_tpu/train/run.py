@@ -27,6 +27,7 @@ from pdnlp_tpu.parallel import (
     make_parallel_train_step, make_shardmap_train_step, init_runtime,
     setup_sharded_model,
 )
+from pdnlp_tpu.parallel.execution import make_parallel_multi_step
 from pdnlp_tpu.train.setup import setup_data
 from pdnlp_tpu.train.trainer import Trainer
 from pdnlp_tpu.utils.config import Args
@@ -59,8 +60,13 @@ def build_parallel_trainer(
     else:
         train_step = make_parallel_train_step(cfg, tx, args, mesh, shardings)
     eval_step = make_parallel_eval_step(cfg, args, mesh, shardings["params"])
+    multi_step = put_fused = None
+    if args.fuse_steps > 1 and not explicit_collectives:
+        multi_step = make_parallel_multi_step(cfg, tx, args, mesh, shardings)
+        put_fused = make_global_batch(mesh, leading_stack=True)
     trainer = Trainer(args, cfg, state, train_step, eval_step,
-                      put=make_global_batch(mesh))
+                      put=make_global_batch(mesh),
+                      multi_step=multi_step, put_fused=put_fused)
     rank0_print(
         f"mesh: {dict(mesh.shape)}  process {jax.process_index()}/{jax.process_count()}"
         f"  mode: {mode}{' +shard_map' if explicit_collectives else ''}"

@@ -98,6 +98,33 @@ def make_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args
     return jax.jit(build_train_step(cfg, tx, args), donate_argnums=0)
 
 
+def build_multi_step(step_fn: Callable) -> Callable:
+    """``lax.scan`` K sequential optimizer steps into ONE device program.
+
+    Math-identical to K separate calls (same updates, in order; per-step
+    metrics come back stacked ``[K]``) — what changes is dispatch: one
+    host->device round trip per K steps instead of per step; the TPU twin
+    of CUDA-graph step capture.  Measured caveat on this benchmark's shapes
+    (BERT-base, batch 32, one v5e): K=8 is ~60% *slower* than per-step
+    dispatch — scan-carried weights cost XLA layout/fusion freedom — so the
+    default stays ``fuse_steps=1``; the knob is for genuinely
+    dispatch-bound deployments (tiny models, high-latency links).
+    """
+
+    def multi_step(state: State, batches: Dict[str, jax.Array]
+                   ) -> Tuple[State, Metrics]:
+        return jax.lax.scan(step_fn, state, batches)
+
+    return multi_step
+
+
+def make_multi_step(cfg: BertConfig, tx: optax.GradientTransformation, args
+                    ) -> Callable[[State, Dict[str, jax.Array]], Tuple[State, Metrics]]:
+    """Jitted K-step fusion for single-device runs (batches: ``[K, B, ...]``)."""
+    return jax.jit(build_multi_step(build_train_step(cfg, tx, args)),
+                   donate_argnums=0)
+
+
 def build_eval_step(cfg: BertConfig, args) -> Callable[..., Metrics]:
     """Unjitted deterministic eval step returning global sums (host
     accumulates).

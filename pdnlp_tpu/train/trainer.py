@@ -40,6 +40,8 @@ class Trainer:
         train_step: Callable,
         eval_step: Callable,
         put: Optional[Callable] = None,
+        multi_step: Optional[Callable] = None,
+        put_fused: Optional[Callable] = None,
     ):
         self.args = args
         self.cfg = cfg
@@ -47,7 +49,28 @@ class Trainer:
         self.train_step = train_step
         self.eval_step = eval_step
         self.put = put or (lambda b: b)
+        # K-step fusion (steps.build_multi_step): one dispatch per K
+        # optimizer steps; the loader's remainder runs through train_step
+        self.multi_step = multi_step
+        self.put_fused = put_fused or self.put
         self.best_accuracy = 0.0
+
+    def _macro_batches(self, loader, k: int):
+        """Yield (batch, n_steps, fused): groups of ``k`` host batches
+        stacked on a leading step axis, remainder as single steps."""
+        if k <= 1 or self.multi_step is None:
+            for b in loader:
+                yield b, 1, False
+            return
+        buf = []
+        for b in loader:
+            buf.append(b)
+            if len(buf) == k:
+                yield ({key: np.stack([x[key] for x in buf]) for key in buf[0]},
+                       k, True)
+                buf = []
+        for b in buf:
+            yield b, 1, False
 
     # ------------------------------------------------------------------ train
     def train(self, train_loader, dev_loader=None) -> float:
@@ -56,23 +79,32 @@ class Trainer:
         total_step = len(train_loader) * args.epochs
         gstep = 0
         pending: Tuple[int, int, jax.Array] | None = None  # (epoch, gstep, loss)
-        metrics = None
+        last_loss = None
         profiler = Profiler(getattr(args, "profile_dir", None))
+        fuse = getattr(args, "fuse_steps", 1)
         examples = 0
         start = time.time()
         for epoch in range(1, args.epochs + 1):
             train_loader.set_epoch(epoch - 1)
-            for batch in train_loader:
-                self.state, metrics = self.train_step(self.state, self.put(batch))
-                gstep += 1
+            for batch, n, fused in self._macro_batches(train_loader, fuse):
+                if fused:
+                    self.state, metrics = self.multi_step(
+                        self.state, self.put_fused(batch))
+                    last_loss = metrics["loss"][-1]
+                else:
+                    self.state, metrics = self.train_step(self.state, self.put(batch))
+                    last_loss = metrics["loss"]
+                prev = gstep
+                gstep += n
                 examples += int(batch["example_weight"].sum())
                 profiler.step(gstep)
-                if gstep % args.log_every == 0:
-                    if pending is not None:  # print the *previous* step's loss:
+                if gstep // args.log_every != prev // args.log_every:
+                    if pending is not None:  # print the *previous* line's loss:
                         e, s, l = pending     # it is done by now — no sync stall
                         rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
-                    pending = (epoch, gstep, metrics["loss"])
-                if dev_loader is not None and args.dev and gstep % args.eval_step == 0:
+                    pending = (epoch, gstep, last_loss)
+                if dev_loader is not None and args.dev and \
+                        gstep // args.eval_step != prev // args.eval_step:
                     self._dev_and_maybe_save(dev_loader)
         if pending is not None:
             e, s, l = pending
@@ -82,8 +114,8 @@ class Trainer:
         # return before every prior step has run.  block_until_ready alone
         # is not trustworthy on async-RPC device tunnels (observed on the
         # 'axon' TPU platform: it returns at enqueue, not completion).
-        if metrics is not None:
-            float(jax.device_get(metrics["loss"]))
+        if last_loss is not None:
+            float(jax.device_get(last_loss))
         jax.block_until_ready(self.state["params"])
         profiler.close()
         minutes = (time.time() - start) / 60
@@ -116,13 +148,6 @@ class Trainer:
         restored = ckpt.load_state(path, self.state)
         self.state = jax.device_put(restored, _shardings_of(self.state))
 
-
-def _shardings_of(state):
-    """Current sharding tree of a live state (resume re-places restored host
-    arrays exactly where the originals lived — replicated or ZeRO-sharded)."""
-    return jax.tree_util.tree_map(
-        lambda x: x.sharding if isinstance(x, jax.Array) else None, state)
-
     # ------------------------------------------------------------------- eval
     def _evaluate(self, loader, collect_preds: bool) -> Dict:
         y_true, y_pred = [], []
@@ -149,3 +174,10 @@ def _shardings_of(state):
         """Eval + predictions: feeds the classification report
         (``/root/reference/test.py:144-170``)."""
         return self._evaluate(loader, collect_preds=True)
+
+
+def _shardings_of(state):
+    """Current sharding tree of a live state (resume re-places restored host
+    arrays exactly where the originals lived — replicated or ZeRO-sharded)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.sharding if isinstance(x, jax.Array) else None, state)

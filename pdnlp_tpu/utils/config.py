@@ -56,6 +56,7 @@ class Args:
     strategy: str = "single"                      # single|pmap|dp|shardmap|zero|...
     remat: bool = False                           # activation checkpointing (ZeRO analog)
     attention_impl: str = "auto"                  # auto|xla|pallas
+    fuse_steps: int = 1                           # K optimizer steps per dispatch
     num_devices: Optional[int] = None             # cap mesh size (None = all)
     mesh_shape: Optional[dict] = None             # e.g. {"dp": 2, "tp": 2, "sp": 2}
     prefetch: int = 2                             # host->device pipeline depth

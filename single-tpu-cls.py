@@ -14,6 +14,7 @@ import jax
 
 from pdnlp_tpu.data.corpus import LABELS
 from pdnlp_tpu.train import Trainer, make_eval_step, make_train_step, setup_data, setup_model
+from pdnlp_tpu.train.steps import make_multi_step
 from pdnlp_tpu.utils.config import Args, parse_cli
 from pdnlp_tpu.utils.logging import rank0_print
 from pdnlp_tpu.utils.metrics import classification_report
@@ -24,8 +25,10 @@ def main(args: Args) -> float:
     cfg, tx, state = setup_model(args, tok.vocab_size)
     rank0_print(f"device: {jax.devices()[0].platform}  model: {args.model}  "
                 f"dtype: {args.dtype}  steps/epoch: {len(train_loader)}")
-    trainer = Trainer(args, cfg, state,
-                      make_train_step(cfg, tx, args), make_eval_step(cfg, args))
+    trainer = Trainer(
+        args, cfg, state,
+        make_train_step(cfg, tx, args), make_eval_step(cfg, args),
+        multi_step=make_multi_step(cfg, tx, args) if args.fuse_steps > 1 else None)
     minutes = trainer.train(train_loader, dev_loader)
     # dev set doubles as the test set (single-gpu-cls.py:241-247)
     result = trainer.test(dev_loader)
