@@ -79,7 +79,9 @@ def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
         loss = jax.lax.psum(loss, SEQ)
         correct = jax.lax.psum(correct, SEQ)
         lw = jax.lax.psum(lw, SEQ)
-        gw = jax.lax.psum(lw, DATA)
+        # max(·, 1) guard matches steps.build_train_step: an all-filler
+        # global batch must yield 0 loss/grads, not 0/0 NaN.
+        gw = jnp.maximum(jax.lax.psum(lw, DATA), 1.0)
         scale = lw / gw
         grads = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g * scale, DATA), grads)

@@ -24,6 +24,7 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 
 from pdnlp_tpu.data.loader import DataLoader
+from pdnlp_tpu.data.sampler import DistributedShardSampler
 from pdnlp_tpu.parallel import (
     init_runtime, local_batch_mult, make_global_batch, make_mesh,
 )
@@ -76,9 +77,21 @@ class Accelerator:
         mult = local_batch_mult(self.mesh)
         prepared = []
         for loader in loaders:
+            sampler = loader.sampler
+            if jax.process_count() > 1 and sampler.num_shards != jax.process_count():
+                # Multi-process: each host must feed a DISJOINT shard, or
+                # make_array_from_process_local_data assembles a global batch
+                # of process_count duplicates (the reference's sampler-less
+                # DeepSpeed/Accelerate double-count, SURVEY.md §7 — here it
+                # would silently corrupt training, not just eval reports).
+                sampler = DistributedShardSampler(
+                    sampler.num_examples, jax.process_count(),
+                    jax.process_index(), shuffle=sampler.shuffle,
+                    seed=sampler.seed, drop_last=sampler.drop_last,
+                )
             scaled = DataLoader(
                 loader.data, loader.collator, loader.batch_size * mult,
-                sampler=loader.sampler, drop_last=loader.drop_last,
+                sampler=sampler, drop_last=loader.drop_last,
                 prefetch=loader.prefetch,
             )
             prepared.append(_PreparedLoader(scaled, self.put))

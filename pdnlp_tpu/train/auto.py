@@ -101,6 +101,7 @@ class AutoTrainer:
         gstep = 0
         total = len(self.train_loader) * targs.num_train_epochs
         start = time.time()
+        metrics = None
         for epoch in range(1, targs.num_train_epochs + 1):
             self.train_loader.set_epoch(epoch - 1)
             for batch in self.train_loader:
@@ -113,7 +114,8 @@ class AutoTrainer:
                     self._eval_and_log(gstep)
                 if gstep % targs.save_steps == 0:
                     self._save_checkpoint(gstep)
-        float(jax.device_get(metrics["loss"]))  # completion barrier
+        if metrics is not None:
+            float(jax.device_get(metrics["loss"]))  # completion barrier
         runtime = time.time() - start
         if targs.load_best_model_at_end and self.best_ckpt:
             path = os.path.join(self.best_ckpt, "model.msgpack")
@@ -143,6 +145,12 @@ class AutoTrainer:
         if better:
             self.best_metric = val
             self.best_ckpt = self._ckpt_dir(gstep)
+            # A best model must exist on disk for load_best_model_at_end even
+            # when eval_steps is not aligned to save_steps (HF Trainer instead
+            # forbids the misalignment); _save_checkpoint dedupes, so a
+            # coinciding save_steps boundary won't write twice.
+            if self.targs.load_best_model_at_end:
+                self._save_checkpoint(gstep)
 
     # ----------------------------------------------------------- checkpoints
     def _ckpt_dir(self, gstep: int) -> str:
@@ -150,6 +158,8 @@ class AutoTrainer:
 
     def _save_checkpoint(self, gstep: int) -> None:
         d = self._ckpt_dir(gstep)
+        if any(dir_ == d for _, dir_ in self.state_history):
+            return  # already written this step (best-model save + save_steps)
         # all processes enter (consolidate is collective); rank 0 writes
         ckpt.save_params(os.path.join(d, "model.msgpack"), self._trainer.state)
         self.state_history.append((gstep, d))

@@ -150,10 +150,15 @@ class Trainer:
 
     # ------------------------------------------------------------------- eval
     def _evaluate(self, loader, collect_preds: bool) -> Dict:
+        # Dispatch the whole pass first, fetch once at the end: a per-batch
+        # float() would serialize host and device through the dev set (the
+        # train loop's async-dispatch treatment, applied to eval).
+        pending = [self.eval_step(self.state["params"], self.put(batch))
+                   for batch in loader]
+        fetched = jax.device_get(pending)
         y_true, y_pred = [], []
         loss_sum = weight = correct = 0.0
-        for batch in loader:
-            m = self.eval_step(self.state["params"], self.put(batch))
+        for m in fetched:
             loss_sum += float(m["loss_sum"])
             weight += float(m["weight"])
             correct += float(m["correct"])

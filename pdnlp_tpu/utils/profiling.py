@@ -28,23 +28,30 @@ class Profiler:
         self.start_step = start_step
         self.stop_step = start_step + num_steps
         self._active = False
+        self._done = False
 
     def step(self, gstep: int) -> None:
-        """Call once per training step with the global step index."""
-        if not self.dir:
+        """Call once per dispatch with the global step index.  Boundary
+        crossings (not equality) so K-fused steps that jump over
+        ``start_step``/``stop_step`` still open/close the window."""
+        if not self.dir or self._done:
             return
-        if gstep == self.start_step and not self._active:
+        if gstep >= self.start_step and not self._active:
+            # Open even when this dispatch already crossed stop_step (one
+            # K-fused dispatch can jump the whole window): the window slides
+            # forward to trace the NEXT dispatch rather than vanishing.
             import jax
 
             try:
                 jax.profiler.start_trace(self.dir)
                 self._active = True
-                rank0_print(f"[profiler] tracing steps {self.start_step}.."
-                            f"{self.stop_step} -> {self.dir}")
+                rank0_print(f"[profiler] tracing from step {gstep} "
+                            f"(window {self.start_step}..{self.stop_step}) "
+                            f"-> {self.dir}")
             except Exception as e:  # platform without profiler support
                 rank0_print(f"[profiler] trace unavailable: {e}")
                 self.dir = None
-        elif gstep == self.stop_step and self._active:
+        elif gstep >= self.stop_step and self._active:
             self.close()
 
     def close(self) -> None:
@@ -53,6 +60,7 @@ class Profiler:
 
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
 
 
 @dataclasses.dataclass
