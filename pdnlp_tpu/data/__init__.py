@@ -1,5 +1,5 @@
 from pdnlp_tpu.data.corpus import LABELS, label2id, id2label, load_data, split_data
 from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
-from pdnlp_tpu.data.collate import Collator
+from pdnlp_tpu.data.collate import Collator, EncodedDataset
 from pdnlp_tpu.data.sampler import DistributedShardSampler
 from pdnlp_tpu.data.loader import DataLoader

@@ -50,3 +50,35 @@ def _pad_rows(a: np.ndarray, rows: int) -> np.ndarray:
     out = np.zeros((rows,) + a.shape[1:], dtype=a.dtype)
     out[: a.shape[0]] = a
     return out
+
+
+class EncodedDataset:
+    """The whole split tokenized ONCE into contiguous arrays.
+
+    A fixed dataset re-encodes identically every epoch (and every run), so
+    the per-batch work collapses to a numpy fancy-index — the loader's
+    tokenization cost goes from O(epochs x dataset) to O(dataset).  ~15 MB
+    for the 10k-example corpus at seq 128: RAM-resident, no memmap needed.
+    """
+
+    def __init__(self, data: Sequence[Tuple[str, int]],
+                 tokenizer: WordPieceTokenizer, max_seq_len: int = 128):
+        texts = [t for t, _ in data]
+        enc = tokenizer.encode_batch(texts, max_seq_len)  # one (native) pass
+        self.arrays = dict(enc)
+        self.arrays["label"] = np.asarray([l for _, l in data], np.int32)
+        self.n = len(texts)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def take(self, indices: Sequence[int], pad_to: int = 0) -> Batch:
+        """Assemble a batch by row indices; pad with zero-weight filler."""
+        idx = np.asarray(indices, np.int64)
+        n = len(idx)
+        rows = max(pad_to, n)
+        batch: Batch = {k: _pad_rows(v[idx], rows) for k, v in self.arrays.items()}
+        w = np.zeros((rows,), np.float32)
+        w[:n] = 1.0
+        batch["example_weight"] = w
+        return batch

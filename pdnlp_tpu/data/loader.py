@@ -17,7 +17,7 @@ import queue
 import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
-from pdnlp_tpu.data.collate import Batch, Collator
+from pdnlp_tpu.data.collate import Batch, Collator, EncodedDataset
 from pdnlp_tpu.data.sampler import DistributedShardSampler
 
 
@@ -30,13 +30,18 @@ class DataLoader:
         sampler: Optional[DistributedShardSampler] = None,
         drop_last: bool = False,
         prefetch: int = 2,
+        encoded: Optional[EncodedDataset] = None,
     ):
+        """``encoded`` (an :class:`EncodedDataset`) short-circuits collation:
+        batches become numpy fancy-indexes into the one-time-encoded split
+        instead of re-tokenizing every epoch."""
         self.data = data
         self.collator = collator
         self.batch_size = batch_size
         self.sampler = sampler or DistributedShardSampler(len(data), shuffle=False)
         self.drop_last = drop_last
         self.prefetch = prefetch
+        self.encoded = encoded
 
     def __len__(self) -> int:
         n = len(self.sampler)
@@ -45,18 +50,23 @@ class DataLoader:
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
 
-    def _batches(self) -> Iterator[List[Tuple[str, int]]]:
+    def _chunks(self) -> Iterator[List[int]]:
         idx = list(self.sampler)
         for i in range(0, len(idx), self.batch_size):
             chunk = idx[i : i + self.batch_size]
             if self.drop_last and len(chunk) < self.batch_size:
                 return
-            yield [self.data[j] for j in chunk]
+            yield chunk
+
+    def _make(self, chunk: List[int]) -> Batch:
+        if self.encoded is not None:
+            return self.encoded.take(chunk, pad_to=self.batch_size)
+        return self.collator([self.data[j] for j in chunk], pad_to=self.batch_size)
 
     def __iter__(self) -> Iterator[Batch]:
         if self.prefetch <= 0:
-            for ex in self._batches():
-                yield self.collator(ex, pad_to=self.batch_size)
+            for chunk in self._chunks():
+                yield self._make(chunk)
             return
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         _SENTINEL = object()
@@ -64,8 +74,8 @@ class DataLoader:
 
         def worker():
             try:
-                for ex in self._batches():
-                    batch = self.collator(ex, pad_to=self.batch_size)
+                for chunk in self._chunks():
+                    batch = self._make(chunk)
                     # Bounded put that notices consumer abandonment, so an
                     # early `break` in the consumer can't strand us forever.
                     while not stop.is_set():

@@ -172,12 +172,16 @@ class WordPieceTokenizer:
             pieces.extend(wordpiece(tok, self.vocab))
         return pieces
 
-    def encode(self, text: str, max_len: int = 128) -> Tuple[List[int], List[int], List[int]]:
+    def encode_ids(self, text: str, max_len: int = 128) -> List[int]:
+        """Unpadded ``[CLS] ids [SEP]`` (truncated to ``max_len``) — the
+        framing shared by fixed-shape ``encode`` and the packing path."""
         if max_len < 2:
             raise ValueError(f"max_len must be >= 2 ([CLS]+[SEP]), got {max_len}")
         ids = [self.vocab.get(p, self.unk_id) for p in self.tokenize(text)]
-        ids = ids[: max_len - 2]
-        ids = [self.cls_id] + ids + [self.sep_id]
+        return [self.cls_id] + ids[: max_len - 2] + [self.sep_id]
+
+    def encode(self, text: str, max_len: int = 128) -> Tuple[List[int], List[int], List[int]]:
+        ids = self.encode_ids(text, max_len)
         mask = [1] * len(ids)
         pad = max_len - len(ids)
         ids += [self.pad_id] * pad

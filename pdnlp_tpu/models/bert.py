@@ -120,6 +120,7 @@ def encode(
     remat: bool = False,
     attn_impl: str = "xla",
     seq_axis: Optional[str] = None,
+    attn_bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Run the encoder stack; returns hidden states [B, S, H] in ``dtype``.
 
@@ -127,6 +128,11 @@ def encode(
     over (must be inside ``shard_map``).  Position embeddings use global
     positions (shard offset) and attention runs as ring attention over the
     axis (``ops.ring``) — the long-context sequence-parallel path.
+
+    ``attn_bias``: optional additive bias broadcastable to [B, N, S, S]
+    that *replaces* the mask-derived bias — used by the packed-MLM
+    pretraining path for its block-diagonal segment mask
+    (``data.packing.segment_bias``).
     """
     B, S = input_ids.shape
     shard_offset = 0
@@ -149,7 +155,12 @@ def encode(
         rng, k = jax.random.split(rng)
         x = _dropout(x, cfg.dropout, k)
 
-    if seq_axis is None:
+    if attn_bias is not None:
+        if seq_axis is not None:
+            raise ValueError("attn_bias override is not supported on the "
+                             "sequence-parallel (ring attention) path")
+        bias = attn_bias.astype(dtype)
+    elif seq_axis is None:
         bias = mask_bias(attention_mask, dtype)
     else:
         # same additive-mask semantics, squeezed to the [B, S_local] rows the
@@ -200,6 +211,35 @@ def encode(
         layer, (x, rng), (params["layers"], jnp.arange(cfg.num_layers))
     )
     return x
+
+
+def init_mlm_head(key: jax.Array, cfg: BertConfig) -> Params:
+    """Masked-LM head params (kept as a SEPARATE tree so classification
+    checkpoints and the fine-tune model never carry it): dense transform +
+    LayerNorm, then a decoder TIED to the word-embedding matrix plus a
+    per-token output bias — the standard BERT MLM head, which the reference
+    never needs because it downloads already-pretrained weights
+    (``/root/reference/single-gpu-cls.py:252``)."""
+    H = cfg.hidden_size
+    return {
+        "transform": _dense_init(key, H, H, cfg.initializer_range),
+        "ln": _ln_init(H),
+        "bias": jnp.zeros((cfg.vocab_size,), jnp.float32),
+    }
+
+
+def mlm_logits(params: Params, head: Params, cfg: BertConfig,
+               hidden: jax.Array, *, dtype=jnp.float32) -> jax.Array:
+    """[B, S, H] encoder output -> [B, S, vocab] logits (fp32).
+
+    The decoder weight is ``params['embeddings']['word']`` transposed (weight
+    tying): on a corpus this small the embedding table gets gradient signal
+    from every masked position, not just from input lookups."""
+    h = jax.nn.gelu(_dense(hidden, head["transform"], dtype), approximate=False)
+    h = _layer_norm(h, head["ln"]["scale"], head["ln"]["bias"], cfg.layer_norm_eps)
+    word = params["embeddings"]["word"].astype(dtype)
+    logits = jnp.einsum("bsh,vh->bsv", h, word) + head["bias"].astype(dtype)
+    return logits.astype(jnp.float32)
 
 
 def classify(

@@ -55,6 +55,14 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp"
     state_shapes = jax.eval_shape(init_fn, init_key, train_rng)
     shardings = state_shardings(state_shapes, mesh, mode)
     state = jax.jit(init_fn, out_shardings=shardings)(init_key, train_rng)
+    if getattr(args, "init_from", None):
+        # warm-start the encoder from an in-repo pretrain checkpoint (the
+        # from_pretrained analog); head stays fresh, placement is preserved
+        # (ZeRO leaves go straight to their shards)
+        from pdnlp_tpu.train.pretrain import load_encoder
+
+        params = load_encoder(args.init_from, state["params"])
+        state["params"] = jax.device_put(params, shardings["params"])
     return cfg, tx, state, shardings
 
 

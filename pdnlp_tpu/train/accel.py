@@ -92,7 +92,7 @@ class Accelerator:
             scaled = DataLoader(
                 loader.data, loader.collator, loader.batch_size * mult,
                 sampler=sampler, drop_last=loader.drop_last,
-                prefetch=loader.prefetch,
+                prefetch=loader.prefetch, encoded=loader.encoded,
             )
             prepared.append(_PreparedLoader(scaled, self.put))
         return (state, *prepared)

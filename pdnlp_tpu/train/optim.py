@@ -41,11 +41,15 @@ def decay_mask(params) -> object:
     return debias(params, masked)
 
 
-def build_optimizer(params, args) -> optax.GradientTransformation:
+def build_optimizer(params, args, schedule=None) -> optax.GradientTransformation:
     """AdamW lr/b1/b2/eps/wd from ``Args`` (defaults mirror
-    ``single-gpu-cls.py:86-97``: lr 3e-5, decay 0.01, no schedule)."""
+    ``single-gpu-cls.py:86-97``: lr 3e-5, decay 0.01, no schedule).
+
+    ``schedule`` overrides the constant learning rate — used by the MLM
+    pretraining stage (warmup+decay), never by fine-tuning, which keeps the
+    reference's constant-lr semantics."""
     return optax.adamw(
-        learning_rate=args.learning_rate,
+        learning_rate=schedule if schedule is not None else args.learning_rate,
         b1=args.adam_b1,
         b2=args.adam_b2,
         eps=args.adam_eps,

@@ -1,0 +1,240 @@
+"""In-repo MLM pretraining — the TPU-native twin of "load pretrained weights".
+
+The reference's whole benchmark fine-tunes *pretrained*
+``hfl/chinese-bert-wwm-ext`` (``/root/reference/single-gpu-cls.py:252-255``)
+and owes its ~0.57 dev accuracy to those weights; this environment has no
+egress and no checkpoint, so the capability is rebuilt as a pretraining
+*stage*: masked-LM over the full 40,133-text corpus (the fine-tune split
+only ever uses the first 10,000 — ``single-gpu-cls.py:226`` — so the rest
+is free pretraining data), then fine-tune from the saved encoder.
+
+TPU-native choices:
+- **packing** (``data.packing``): ~7 texts per 128-token row behind a
+  block-diagonal segment mask — ~7x the tokens/FLOP of padded rows;
+- **masking on device**: the 80/10/10 BERT corruption runs inside the
+  jitted step (threefry, static shapes), re-sampled every step for free
+  dynamic masking — no host-side mask materialization;
+- **mesh DP**: batch sharded along ``data``, state replicated; the same
+  placement story as the fine-tune strategies.
+
+Held-out hygiene: the fine-tune DEV split's texts are excluded from the
+pretraining stream (the reference's downloaded weights never saw them
+either).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pdnlp_tpu.data.corpus import load_data, split_data
+from pdnlp_tpu.data.packing import pack_texts, segment_bias
+from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, get_or_build_vocab
+from pdnlp_tpu.models import bert, get_config
+from pdnlp_tpu.parallel import make_global_batch, make_mesh
+from pdnlp_tpu.parallel.sharding import batch_sharding, replicated
+from pdnlp_tpu.train import checkpoint as ckpt
+from pdnlp_tpu.train.optim import build_optimizer
+from pdnlp_tpu.train.precision import resolve_dtype
+from pdnlp_tpu.utils.logging import rank0_print
+from pdnlp_tpu.utils.seeding import set_seed
+
+N_SPECIALS = 5  # [PAD],[UNK],[CLS],[SEP],[MASK] — ids 0..4, never masked
+
+
+def mask_tokens(rng: jax.Array, input_ids: jax.Array, mask_id: int,
+                vocab_size: int, mlm_prob: float = 0.15
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """BERT's 80/10/10 corruption, traced on device.
+
+    Returns ``(corrupted_ids, labels, weights)``: labels are the original
+    ids, weights select the masked positions (0 elsewhere).  Only real
+    tokens (id >= N_SPECIALS) are candidates, so [CLS]/[SEP]/[PAD] and
+    packing filler never train the head.
+    """
+    k_sel, k_split, k_rand = jax.random.split(rng, 3)
+    maskable = input_ids >= N_SPECIALS
+    selected = (jax.random.uniform(k_sel, input_ids.shape) < mlm_prob) & maskable
+    u = jax.random.uniform(k_split, input_ids.shape)
+    random_ids = jax.random.randint(
+        k_rand, input_ids.shape, N_SPECIALS, vocab_size, dtype=input_ids.dtype)
+    corrupted = jnp.where(u < 0.8, mask_id,
+                          jnp.where(u < 0.9, random_ids, input_ids))
+    corrupted = jnp.where(selected, corrupted, input_ids)
+    return corrupted, input_ids, selected.astype(jnp.float32)
+
+
+def build_mlm_step(cfg, tx, args, mask_id: int):
+    """Fused MLM train step: corrupt -> encode(packed) -> tied head -> CE ->
+    AdamW.  ``state['params']`` carries the encoder tree plus an ``'mlm'``
+    subtree (head), stripped again at fine-tune load time."""
+    dtype = resolve_dtype(args.dtype)
+    remat = bool(args.remat)
+
+    def loss_fn(params, batch, rng):
+        k_mask, k_drop = jax.random.split(rng)
+        ids, labels, w = mask_tokens(k_mask, batch["input_ids"], mask_id,
+                                     cfg.vocab_size, args.mlm_prob)
+        seg = batch["segment_ids"]
+        hidden = bert.encode(
+            params, cfg, ids, jnp.zeros_like(ids), (seg > 0).astype(jnp.int32),
+            dtype=dtype, deterministic=False, rng=k_drop, remat=remat,
+            attn_bias=segment_bias(seg),
+        )
+        logits = bert.mlm_logits(params, params["mlm"], cfg, hidden, dtype=dtype)
+        logp = jax.nn.log_softmax(logits)
+        ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        wsum = jnp.maximum(w.sum(), 1.0)
+        loss = (ce * w).sum() / wsum
+        correct = ((jnp.argmax(logits, -1) == labels) * w).sum()
+        return loss, (correct, wsum)
+
+    def train_step(state, batch):
+        rng = jax.random.fold_in(state["rng"], state["step"])
+        (loss, (correct, wsum)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"], batch, rng)
+        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {"params": params, "opt_state": opt_state,
+                     "step": state["step"] + 1, "rng": state["rng"]}
+        return new_state, {"loss": loss, "mask_acc": correct / wsum}
+
+    return train_step
+
+
+class PackedLoader:
+    """Epoch-shuffled batches over pre-packed rows (all-numpy, no re-pack)."""
+
+    def __init__(self, packed: Dict[str, np.ndarray], batch_size: int,
+                 seed: int = 123):
+        self.packed = packed
+        self.batch_size = batch_size
+        self.seed = seed
+        self.epoch = 0
+        self.n = len(packed["input_ids"])
+
+    def __len__(self) -> int:
+        return self.n // self.batch_size  # drop_last: static shapes for free
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        order = np.random.RandomState(self.seed + self.epoch).permutation(self.n)
+        for i in range(0, len(self) * self.batch_size, self.batch_size):
+            idx = order[i : i + self.batch_size]
+            yield {k: v[idx] for k, v in self.packed.items()}
+
+
+def build_pretrain_corpus(args, tok: WordPieceTokenizer) -> Dict[str, np.ndarray]:
+    """Pack every corpus text EXCEPT the fine-tune dev split's."""
+    data = load_data(args.data_path)
+    _, dev = split_data(data, seed=args.seed, limit=args.data_limit,
+                        ratio=args.ratio)
+    held_out = {t for t, _ in dev}
+    texts = [t for t, _ in data if t not in held_out]
+    if args.pretrain_limit:
+        texts = texts[: args.pretrain_limit]
+    packed = pack_texts(tok, texts, args.max_seq_len)
+    rank0_print(f"pretrain corpus: {len(texts)} texts "
+                f"({len(data) - len(texts)} dev-held-out) -> "
+                f"{len(packed['input_ids'])} packed rows of {args.max_seq_len}")
+    return packed
+
+
+def run_pretrain(args) -> str:
+    """Pretrain and write the encoder checkpoint; returns its path.
+
+    The saved tree is the pretrain *params* (encoder + ``mlm`` head);
+    ``load_encoder`` keeps the encoder and drops the head.  This is a
+    weights artifact, not a resume point — optimizer moments and the
+    schedule step are not saved (use ``Trainer.save_resume`` semantics if
+    interruptible multi-hour pretrains ever matter; this corpus pretrains
+    in minutes).
+    """
+    set_seed(args.seed)
+    mesh = make_mesh(num_devices=args.num_devices, shape=args.mesh_shape)
+    tok = WordPieceTokenizer(get_or_build_vocab(args))
+    packed = build_pretrain_corpus(args, tok)
+    loader = PackedLoader(packed, args.train_batch_size, seed=args.seed)
+
+    cfg = get_config(args.model, vocab_size=tok.vocab_size,
+                     num_labels=args.num_labels, dropout=args.dropout,
+                     attn_dropout=args.attn_dropout)
+    root = jax.random.PRNGKey(args.seed)
+    k_init, k_head, k_train = jax.random.split(root, 3)
+    params = bert.init_params(k_init, cfg)
+    params["mlm"] = bert.init_mlm_head(k_head, cfg)
+    # From-scratch MLM needs a warmup->decay schedule (fine-tuning doesn't:
+    # the reference uses constant 3e-5 on a pretrained trunk, which
+    # build_optimizer mirrors).  BERT-style: linear warmup over the first
+    # ~6%, cosine decay to zero.
+    total_steps = max(1, len(loader) * args.epochs)
+    tx = build_optimizer(params, args, schedule=optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=args.learning_rate,
+        warmup_steps=max(1, total_steps * 6 // 100),
+        decay_steps=total_steps))
+    state = {"params": params, "opt_state": tx.init(params),
+             "step": jnp.zeros((), jnp.int32), "rng": jax.random.key(args.seed)}
+
+    step_fn = jax.jit(
+        build_mlm_step(cfg, tx, args, mask_id=tok.vocab["[MASK]"]),
+        donate_argnums=0,
+        in_shardings=(replicated(mesh),
+                      {k: batch_sharding(mesh) for k in packed}),
+        out_shardings=(replicated(mesh), replicated(mesh)),
+    )
+    put = make_global_batch(mesh)
+
+    rank0_print(f"pretraining {args.model}: {args.epochs} epochs x "
+                f"{len(loader)} steps, batch {args.train_batch_size}, "
+                f"dtype {args.dtype}")
+    start = time.time()
+    last = None
+    for epoch in range(1, args.epochs + 1):
+        loader.set_epoch(epoch - 1)
+        for batch in loader:
+            state, m = step_fn(state, put(batch))
+            last = m
+        if last is not None and (
+                epoch % max(1, args.epochs // 30) == 0 or epoch == args.epochs):
+            rank0_print(f"[pretrain] epoch {epoch}/{args.epochs} "
+                        f"loss {float(last['loss']):.4f} "
+                        f"mask_acc {float(last['mask_acc']):.4f}")
+    if last is not None:
+        float(jax.device_get(last["loss"]))  # completion barrier
+    minutes = (time.time() - start) / 60
+    rank0_print(f"pretrain 耗时：{minutes:.4f}分钟")
+    path = args.ckpt_path("pretrained.msgpack")
+    ckpt.save_params(path, state)
+    rank0_print(f"pretrained encoder -> {path}")
+    return path
+
+
+def load_encoder(path: str, params):
+    """Initialize fine-tune params from a pretrain checkpoint: embeddings +
+    layers come from the file, pooler/classifier stay at fresh init — the
+    ``from_pretrained`` analog (new head on a pretrained trunk)."""
+    import flax.serialization as ser
+
+    with open(path, "rb") as f:
+        restored = ser.msgpack_restore(f.read())
+    out = dict(params)
+    for key in ("embeddings", "layers"):
+        if key not in restored:
+            raise ValueError(f"{path!r} has no {key!r} tree — not a "
+                             "pretrain checkpoint?")
+        tmpl = params[key]
+        got = jax.tree_util.tree_map(jnp.asarray, restored[key])
+        t_shapes = jax.tree_util.tree_map(lambda l: l.shape, tmpl)
+        g_shapes = jax.tree_util.tree_map(lambda l: l.shape, got)
+        if t_shapes != g_shapes:
+            raise ValueError(
+                f"pretrained {key!r} shapes do not match the model: "
+                f"{g_shapes} vs {t_shapes}")
+        out[key] = got
+    return out

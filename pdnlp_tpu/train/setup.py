@@ -36,16 +36,23 @@ def setup_data(args, *, num_shards: int = 1, shard_id: int = 0,
 
     native.attach(tok)  # no-op unless `make -C csrc` has been run
     col = Collator(tok, args.max_seq_len)
+    from pdnlp_tpu.data.collate import EncodedDataset
+
+    # one-time encode of each split: epochs re-index cached arrays instead
+    # of re-tokenizing (identical bytes either way — Collator stays the
+    # reference-semantics spec and the parity test pins them equal)
+    train_enc = EncodedDataset(train, tok, args.max_seq_len)
+    dev_enc = EncodedDataset(dev, tok, args.max_seq_len)
     train_loader = DataLoader(
         train, col, args.train_batch_size * device_batch_mult,
         sampler=DistributedShardSampler(len(train), num_shards, shard_id,
                                         shuffle=True, seed=args.seed),
-        prefetch=args.prefetch,
+        prefetch=args.prefetch, encoded=train_enc,
     )
     dev_loader = DataLoader(
         dev, col, args.dev_batch_size * device_batch_mult,
         sampler=DistributedShardSampler(len(dev), num_shards, shard_id, shuffle=False),
-        prefetch=args.prefetch,
+        prefetch=args.prefetch, encoded=dev_enc,
     )
     return train_loader, dev_loader, tok
 
@@ -59,6 +66,10 @@ def setup_model(args, vocab_size: int):
     root = set_seed(args.seed)
     init_key, train_rng = jax.random.split(root)
     params = bert.init_params(init_key, cfg)
+    if getattr(args, "init_from", None):
+        from pdnlp_tpu.train.pretrain import load_encoder
+
+        params = load_encoder(args.init_from, params)
     tx = build_optimizer(params, args)
     state = init_state(init_key, cfg, tx, rng=train_rng, params=params)
     return cfg, tx, state

@@ -35,6 +35,9 @@ class Args:
     num_labels: int = 6
     dropout: float = 0.1
     attn_dropout: float = 0.1                     # attention_probs_dropout_prob
+    init_from: Optional[str] = None               # pretrain ckpt: encoder warm-start
+    mlm_prob: float = 0.15                        # pretraining mask rate
+    pretrain_limit: Optional[int] = None          # cap pretrain texts (tests)
 
     # --- optimization (single-gpu-cls.py:86-97,193-205) ---
     learning_rate: float = 3e-5

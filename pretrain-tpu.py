@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""MLM pretraining over the full corpus — the "download pretrained weights"
+capability, rebuilt in-repo.
+
+The reference's accuracy comes from ``hfl/chinese-bert-wwm-ext``
+(``/root/reference/single-gpu-cls.py:252-255``); with no egress, this stage
+produces the equivalent warm-start: masked-LM over all 40,133 corpus texts
+(minus the fine-tune dev split), packed ~7 texts per 128-token row behind a
+block-diagonal segment mask, 80/10/10 dynamic masking on device.
+
+    python pretrain-tpu.py                         # -> output/pretrained.msgpack
+    python multi-tpu-jax-cls.py --dtype bfloat16 \
+        --init_from output/pretrained.msgpack      # fine-tune from it
+"""
+from pdnlp_tpu.train.pretrain import run_pretrain
+from pdnlp_tpu.utils.config import Args, parse_cli
+
+
+def main() -> None:
+    args = parse_cli(base=Args(
+        strategy="pretrain",
+        dtype="bfloat16",          # pretraining has no fp32-parity story to keep
+        train_batch_size=64,       # packed rows (~7 texts each)
+        epochs=150,
+        learning_rate=2e-4,        # fresh-init MLM wants more than 3e-5
+        log_every=10 ** 9,
+    ))
+    run_pretrain(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -164,3 +164,37 @@ def test_loader_drop_last(data, tok):
     col = Collator(tok, max_seq_len=16)
     loader = DataLoader(data[:70], col, batch_size=32, drop_last=True, prefetch=0)
     assert len(list(loader)) == len(loader) == 2
+
+
+def test_encoded_dataset_matches_collator(data, tok):
+    """The cached-encoding fast path must be byte-identical to on-demand
+    collation — EncodedDataset is an optimization, never a semantic."""
+    from pdnlp_tpu.data import EncodedDataset
+
+    subset = data[:100]
+    col = Collator(tok, max_seq_len=32)
+    enc = EncodedDataset(subset, tok, max_seq_len=32)
+    idx = [5, 0, 99, 42]
+    a = col([subset[i] for i in idx], pad_to=8)
+    b = enc.take(idx, pad_to=8)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_loader_encoded_equals_plain(data, tok):
+    """A DataLoader with cached encodings yields the same batch stream."""
+    from pdnlp_tpu.data import EncodedDataset
+
+    subset = data[:70]
+    col = Collator(tok, max_seq_len=32)
+    sampler = lambda: DistributedShardSampler(len(subset), shuffle=True, seed=7)
+    plain = DataLoader(subset, col, 16, sampler=sampler(), prefetch=0)
+    cached = DataLoader(subset, col, 16, sampler=sampler(), prefetch=2,
+                        encoded=EncodedDataset(subset, tok, max_seq_len=32))
+    for epoch in range(2):
+        plain.set_epoch(epoch)
+        cached.set_epoch(epoch)
+        for a, b in zip(plain, cached):
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k], err_msg=k)
