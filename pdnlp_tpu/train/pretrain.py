@@ -47,18 +47,40 @@ N_SPECIALS = 5  # [PAD],[UNK],[CLS],[SEP],[MASK] — ids 0..4, never masked
 
 
 def mask_tokens(rng: jax.Array, input_ids: jax.Array, mask_id: int,
-                vocab_size: int, mlm_prob: float = 0.15
-                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                vocab_size: int, mlm_prob: float = 0.15,
+                span: bool = False) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """BERT's 80/10/10 corruption, traced on device.
 
     Returns ``(corrupted_ids, labels, weights)``: labels are the original
     ids, weights select the masked positions (0 elsewhere).  Only real
     tokens (id >= N_SPECIALS) are candidates, so [CLS]/[SEP]/[PAD] and
     packing filler never train the head.
+
+    ``span=True`` selects contiguous n-grams (40/30/20/10% of length
+    1/2/3/4, expected 2) instead of i.i.d. positions — the segmenter-free
+    analog of the reference model's Chinese whole-word masking
+    (``hfl/chinese-bert-wwm-ext``): most Chinese words are 2-4 chars, so
+    masking the whole span stops the model answering from the other half
+    of the word.  Spans truncate at specials, so they never cross packed
+    text boundaries.
     """
     k_sel, k_split, k_rand = jax.random.split(rng, 3)
     maskable = input_ids >= N_SPECIALS
-    selected = (jax.random.uniform(k_sel, input_ids.shape) < mlm_prob) & maskable
+    if span:
+        k_sel, k_len = jax.random.split(k_sel)
+        # start-rate = target / E[len]: i.i.d. starts, then extend rightward
+        starts = jax.random.uniform(k_sel, input_ids.shape) < (mlm_prob / 2.0)
+        lens = jax.random.choice(k_len, jnp.arange(1, 5), input_ids.shape,
+                                 p=jnp.array([0.4, 0.3, 0.2, 0.1]))
+        selected = jnp.zeros_like(starts)
+        for k in range(4):
+            cover = starts & (lens > k)
+            if k:  # shift right with zero fill: spans never wrap the row
+                cover = jnp.zeros_like(cover).at[..., k:].set(cover[..., :-k])
+            selected = selected | cover
+        selected = selected & maskable
+    else:
+        selected = (jax.random.uniform(k_sel, input_ids.shape) < mlm_prob) & maskable
     u = jax.random.uniform(k_split, input_ids.shape)
     random_ids = jax.random.randint(
         k_rand, input_ids.shape, N_SPECIALS, vocab_size, dtype=input_ids.dtype)
@@ -78,7 +100,8 @@ def build_mlm_step(cfg, tx, args, mask_id: int):
     def loss_fn(params, batch, rng):
         k_mask, k_drop = jax.random.split(rng)
         ids, labels, w = mask_tokens(k_mask, batch["input_ids"], mask_id,
-                                     cfg.vocab_size, args.mlm_prob)
+                                     cfg.vocab_size, args.mlm_prob,
+                                     span=args.mlm_span)
         seg = batch["segment_ids"]
         hidden = bert.encode(
             params, cfg, ids, jnp.zeros_like(ids), (seg > 0).astype(jnp.int32),
@@ -209,7 +232,7 @@ def run_pretrain(args) -> str:
         float(jax.device_get(last["loss"]))  # completion barrier
     minutes = (time.time() - start) / 60
     rank0_print(f"pretrain 耗时：{minutes:.4f}分钟")
-    path = args.ckpt_path("pretrained.msgpack")
+    path = args.ckpt_path(args.ckpt_name or "pretrained.msgpack")
     ckpt.save_params(path, state)
     rank0_print(f"pretrained encoder -> {path}")
     return path

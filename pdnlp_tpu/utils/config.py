@@ -37,6 +37,7 @@ class Args:
     attn_dropout: float = 0.1                     # attention_probs_dropout_prob
     init_from: Optional[str] = None               # pretrain ckpt: encoder warm-start
     mlm_prob: float = 0.15                        # pretraining mask rate
+    mlm_span: bool = True                         # n-gram (wwm-analog) masking
     pretrain_limit: Optional[int] = None          # cap pretrain texts (tests)
 
     # --- optimization (single-gpu-cls.py:86-97,193-205) ---
