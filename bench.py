@@ -10,6 +10,21 @@ own headline metric (``耗时：X分钟``, ``/root/reference/README.md:10-20``).
 ``vs_baseline`` is the speedup against the published north-star wall-clock —
 2-GPU DDP+AMP, 0.6336 min (``README.md:16``) — so > 1.0 beats it.
 
+Accuracy: the reference fine-tunes *pretrained* ``hfl/chinese-bert-wwm-ext``
+(dev acc ~0.57).  This environment has no egress, so the warm start is
+produced in-repo: ``pretrain-tpu.py`` (masked-LM over the 40k-text corpus,
+fine-tune dev split held out).  The bench fine-tunes from
+``output/pretrained.msgpack``, regenerating it first if absent (~20 min,
+one-time; reruns hit the cached file).  The pretrain stage is NOT part of
+the timed epoch — the reference's download of model_hub weights isn't timed
+either.
+
+Scope: the bench is a SINGLE-HOST harness (the pretrain-cache check is a
+local-filesystem gate; multi-host runs should pretrain explicitly first),
+and ``mfu_pct`` assumes the default pure-DP mesh — under ``--mesh_shape``
+with tp/sp axes the per-chip FLOP share changes and the field is not
+comparable.
+
 Methodology notes (vs the reference's timing):
 - the timed epoch starts AFTER the train step is compiled (AOT ``.lower()
   .compile()``), the analog of the reference's warm CUDA context; XLA's
@@ -22,10 +37,40 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import sys
 
 NORTH_STAR_MIN = 0.6336       # 2-GPU DDP+AMP, README.md:16
 SINGLE_GPU_MIN = 2.8276       # 1-GPU fp32, README.md:12
+# per-chip bf16 peak FLOP/s by device kind (prefix-matched); MFU is only
+# reported when the running chip is recognized
+BF16_PEAK_BY_KIND = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,    # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,    # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def bf16_peak(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in BF16_PEAK_BY_KIND.items():
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def step_flops(cfg, batch: int, seq: int) -> float:
+    """Matmul FLOPs of one fused train step (fwd + 2x bwd), excluding
+    embedding gathers: 6 * (encoder matmul params) * tokens + attention."""
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    mm_params = L * (4 * H * H + 2 * H * I) + H * H  # qkvo + mlp + pooler
+    tokens = batch * seq
+    dense = 6 * mm_params * tokens
+    attn = L * 3 * 2 * 2 * batch * cfg.num_heads * seq * seq * cfg.head_dim
+    return dense + attn
 
 
 def main() -> None:
@@ -37,9 +82,8 @@ def main() -> None:
     from pdnlp_tpu.utils.config import Args, parse_cli
 
     # fuse_steps stays 1: K-step scan fusion is math-identical but measured
-    # SLOWER on this shape (0.37 vs 0.23 min at K=8 — scan-carried weights
-    # lose XLA layout/fusion freedom); it remains a CLI knob for
-    # dispatch-bound deployments.
+    # SLOWER on this shape (scan-carried weights lose XLA layout/fusion
+    # freedom); it remains a CLI knob for dispatch-bound deployments.
     args = parse_cli(base=Args(
         strategy="dp", dtype="bfloat16",
         dev=True,            # suppress the end-of-run checkpoint write
@@ -49,7 +93,34 @@ def main() -> None:
     with contextlib.redirect_stdout(sys.stderr):
         import numpy as np
 
-        trainer, train_loader, dev_loader = build_parallel_trainer(args, mode="dp")
+        pretrain_ckpt = args.ckpt_path("pretrained.msgpack")
+        explicit_init = bool(args.init_from)
+        if not os.path.exists(pretrain_ckpt) and not args.init_from:
+            # one-time in-repo pretraining (the "download weights" analog)
+            try:
+                from pdnlp_tpu.train.pretrain import run_pretrain
+
+                run_pretrain(args.replace(
+                    strategy="pretrain", train_batch_size=64, epochs=150,
+                    learning_rate=2e-4, ckpt_name="pretrained.msgpack"))
+            except Exception as e:  # bench must still produce its JSON line
+                print(f"pretrain stage failed ({type(e).__name__}: {e}); "
+                      "benching from-scratch weights", file=sys.stderr)
+        if os.path.exists(pretrain_ckpt) and not args.init_from:
+            args = args.replace(init_from=pretrain_ckpt)
+
+        try:
+            trainer, train_loader, dev_loader = build_parallel_trainer(args, mode="dp")
+        except Exception as e:
+            # an explicitly requested --init_from must fail loudly; only the
+            # auto-selected cache falls back (e.g. a stale pretrained.msgpack
+            # from a different --model must not kill the JSON line)
+            if explicit_init or not args.init_from:
+                raise
+            print(f"init_from {args.init_from!r} failed ({type(e).__name__}: "
+                  f"{e}); benching from-scratch weights", file=sys.stderr)
+            args = args.replace(init_from=None)
+            trainer, train_loader, dev_loader = build_parallel_trainer(args, mode="dp")
         # compile outside the timer (the reference times a warm CUDA context)
         host_batch = next(iter(train_loader))
         batch = trainer.put(host_batch)
@@ -63,6 +134,17 @@ def main() -> None:
         minutes = trainer.train(train_loader, dev_loader=None)
         loss, acc = trainer.dev(dev_loader)
 
+        steps = len(train_loader) * args.epochs
+        sec_per_step = minutes * 60 / steps
+        # MFU only means something against the matching peak: report it for
+        # bf16 on a recognized TPU generation, null otherwise (fp32 runs at
+        # a different MXU rate; CPU runs have no meaningful peak).
+        mfu = None
+        peak = bf16_peak(jax.devices()[0])
+        if args.dtype == "bfloat16" and peak is not None:
+            mfu = step_flops(trainer.cfg, args.train_batch_size,
+                             args.max_seq_len) / sec_per_step / peak
+
     print(json.dumps({
         "metric": "wall_clock_min_per_epoch",
         "value": round(minutes, 4),
@@ -73,12 +155,19 @@ def main() -> None:
         "dev_accuracy": round(acc, 4),
         "dev_loss": round(loss, 4),
         "steps_per_epoch": len(train_loader),
+        "steps_per_sec": round(1.0 / sec_per_step, 2),
+        "mfu_pct": round(mfu * 100, 1) if mfu is not None else None,
         "devices": jax.device_count(),
         "platform": jax.devices()[0].platform,
         "dtype": args.dtype,
         "fuse_steps": args.fuse_steps,
-        "note": "from-scratch weights (no pretrained ckpt in image); "
-                "reference dev acc 0.57 is from a pretrained model",
+        "init_from": args.init_from,
+        "note": ("fine-tuned from in-repo MLM pretrain over the 40k-text "
+                 "corpus (no egress: the reference's pretrained-checkpoint "
+                 "download is rebuilt as a pretraining stage); reference "
+                 "dev acc target 0.57" if args.init_from else
+                 "from-scratch weights; reference dev acc 0.57 is from a "
+                 "pretrained model"),
     }))
 
 

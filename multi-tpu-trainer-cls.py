@@ -8,25 +8,20 @@ reload — the reference's exact knobs at ``:150-168``), call ``train()`` and
 
     python multi-tpu-trainer-cls.py [--bf16 true] [--eval_steps 50]
 """
-import dataclasses
-
 from pdnlp_tpu.train.auto import AutoTrainer, TrainerArgs
 from pdnlp_tpu.utils.logging import rank0_print
 
 
 def parse_trainer_args(argv=None) -> TrainerArgs:
+    """Typed CLI over ``TrainerArgs`` via the shared dataclass-arg builder
+    (``utils.config.add_dataclass_args`` — one Optional-unwrapping loop for
+    the whole framework)."""
     import argparse
 
+    from pdnlp_tpu.utils.config import add_dataclass_args
+
     p = argparse.ArgumentParser()
-    for f in dataclasses.fields(TrainerArgs):
-        if f.type in ("int", int, "float", float, "str", str, "Optional[int]"):
-            typ = {"int": int, "float": float, "str": str,
-                   "Optional[int]": int}.get(f.type, f.type)
-            p.add_argument(f"--{f.name}", type=typ, default=f.default)
-        elif f.type in ("bool", bool):
-            p.add_argument(f"--{f.name}",
-                           type=lambda s: s.lower() in ("1", "true", "yes"),
-                           default=f.default)
+    add_dataclass_args(p, TrainerArgs)
     ns, _ = p.parse_known_args(argv)
     return TrainerArgs(**vars(ns))
 

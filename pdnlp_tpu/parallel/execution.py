@@ -41,8 +41,11 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp"
     """(cfg, tx, state, shardings) — state lives on the mesh from birth."""
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
                      dropout=args.dropout, attn_dropout=args.attn_dropout)
+    from pdnlp_tpu.utils.seeding import train_key
+
     root = set_seed(args.seed)
-    init_key, train_rng = jax.random.split(root)
+    init_key, _ = jax.random.split(root)
+    train_rng = train_key(args.seed, getattr(args, "rng_impl", "rbg"))
 
     # tx needs a params *structure* for the weight-decay mask — shapes only.
     param_shapes = jax.eval_shape(lambda k: bert.init_params(k, cfg), init_key)

@@ -49,6 +49,7 @@ class TrainerArgs:
     greater_is_better: bool = True
     mode: str = "dp"                      # "zero" = the DeepSpeed delegation
     model: str = "bert-base"
+    init_from: Optional[str] = None       # model_name_or_path analog (pretrain ckpt)
     data_path: str = "/root/reference/data/train.json"
     data_limit: int = 10_000
     max_seq_len: int = 128
@@ -70,6 +71,7 @@ class TrainerArgs:
             dtype="bfloat16" if self.bf16 else "float32",
             data_limit=self.data_limit,
             max_seq_len=self.max_seq_len,
+            init_from=self.init_from,
         )
 
 

@@ -80,16 +80,32 @@ def load(path: str, like) -> Any:
     template = _wrap_rng(like) if isinstance(like, dict) and "rng" in like else like
     with open(path, "rb") as f:
         restored = serialization.from_bytes(template, f.read())
-    got_shapes = [getattr(l, "shape", None) for l in jax.tree_util.tree_leaves(restored)]
-    want_shapes = [getattr(l, "shape", None) for l in jax.tree_util.tree_leaves(template)]
+    got_leaves = jax.tree_util.tree_leaves(restored)
+    want = jax.tree_util.tree_leaves_with_path(template)
+    got_shapes = [getattr(l, "shape", None) for l in got_leaves]
+    want_shapes = [getattr(l, "shape", None) for _, l in want]
     if got_shapes != want_shapes:
-        bad = next((g, w) for g, w in zip(got_shapes, want_shapes) if g != w)
+        (keypath, _), bad_got, bad_want = next(
+            (w, g, ws) for w, g, ws in zip(want, got_shapes, want_shapes)
+            if g != ws)
+        leaf = jax.tree_util.keystr(keypath)
+        # a [2]u32-vs-[4]u32 *rng* leaf means the checkpoint was saved under
+        # a different PRNG impl (threefry2x32 vs rbg), not a different model
+        if "rng" in leaf and {bad_got, bad_want} <= {(2,), (4,)}:
+            raise ValueError(
+                f"checkpoint {path!r} stores an RNG key of a different PRNG "
+                f"impl than the current --rng_impl (key_data {bad_got} vs "
+                f"{bad_want}: threefry2x32 is [2]u32, rbg is [4]u32) — rerun "
+                "with the --rng_impl it was saved under")
         raise ValueError(
             f"checkpoint {path!r} does not match the model template: "
-            f"first mismatching leaf shape {bad[0]} vs expected {bad[1]}")
+            f"leaf {leaf} has shape {bad_got} vs expected {bad_want}")
     if isinstance(restored, dict) and "rng" in restored and isinstance(like, dict):
         restored = dict(restored)
-        restored["rng"] = jax.random.wrap_key_data(restored["rng"])
+        # rewrap with the template key's impl (rbg key_data is [4]u32,
+        # threefry [2]u32 — default wrap would mis-type an rbg stream)
+        restored["rng"] = jax.random.wrap_key_data(
+            restored["rng"], impl=jax.random.key_impl(like["rng"]))
     return restored
 
 

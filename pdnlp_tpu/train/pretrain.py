@@ -72,13 +72,16 @@ def mask_tokens(rng: jax.Array, input_ids: jax.Array, mask_id: int,
         starts = jax.random.uniform(k_sel, input_ids.shape) < (mlm_prob / 2.0)
         lens = jax.random.choice(k_len, jnp.arange(1, 5), input_ids.shape,
                                  p=jnp.array([0.4, 0.3, 0.2, 0.1]))
-        selected = jnp.zeros_like(starts)
-        for k in range(4):
-            cover = starts & (lens > k)
-            if k:  # shift right with zero fill: spans never wrap the row
-                cover = jnp.zeros_like(cover).at[..., k:].set(cover[..., :-k])
-            selected = selected | cover
-        selected = selected & maskable
+        # r_i = remaining span length extending from position i.  Propagate
+        # rightward (max with any new start), zeroing at non-maskable
+        # positions so a span DIES at [SEP]/[PAD] instead of resuming in the
+        # next packed text; 3 steps converge (spans are <= 4 long).
+        init = jnp.where(starts & maskable, lens, 0)
+        r = init
+        for _ in range(3):
+            cont = jnp.zeros_like(r).at[..., 1:].set(r[..., :-1] - 1)
+            r = jnp.maximum(init, jnp.where(maskable, cont, 0))
+        selected = r > 0
     else:
         selected = (jax.random.uniform(k_sel, input_ids.shape) < mlm_prob) & maskable
     u = jax.random.uniform(k_split, input_ids.shape)
@@ -189,7 +192,10 @@ def run_pretrain(args) -> str:
                      num_labels=args.num_labels, dropout=args.dropout,
                      attn_dropout=args.attn_dropout)
     root = jax.random.PRNGKey(args.seed)
-    k_init, k_head, k_train = jax.random.split(root, 3)
+    # 3-way split kept although slot 3 is unused (the dropout stream now
+    # comes from train_key): changing the split would change k_init/k_head
+    # and silently invalidate every existing pretrained.msgpack recipe.
+    k_init, k_head, _ = jax.random.split(root, 3)
     params = bert.init_params(k_init, cfg)
     params["mlm"] = bert.init_mlm_head(k_head, cfg)
     # From-scratch MLM needs a warmup->decay schedule (fine-tuning doesn't:
@@ -201,8 +207,11 @@ def run_pretrain(args) -> str:
         init_value=0.0, peak_value=args.learning_rate,
         warmup_steps=max(1, total_steps * 6 // 100),
         decay_steps=total_steps))
+    from pdnlp_tpu.utils.seeding import train_key
+
     state = {"params": params, "opt_state": tx.init(params),
-             "step": jnp.zeros((), jnp.int32), "rng": jax.random.key(args.seed)}
+             "step": jnp.zeros((), jnp.int32),
+             "rng": train_key(args.seed, getattr(args, "rng_impl", "rbg"))}
 
     step_fn = jax.jit(
         build_mlm_step(cfg, tx, args, mask_id=tok.vocab["[MASK]"]),

@@ -61,10 +61,13 @@ def setup_model(args, vocab_size: int):
     """(cfg, tx, state) — seeded the reference's way (one seed, 123)."""
     from pdnlp_tpu.train.steps import init_state
 
+    from pdnlp_tpu.utils.seeding import train_key
+
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
                      dropout=args.dropout, attn_dropout=args.attn_dropout)
     root = set_seed(args.seed)
-    init_key, train_rng = jax.random.split(root)
+    init_key, _ = jax.random.split(root)
+    train_rng = train_key(args.seed, getattr(args, "rng_impl", "rbg"))
     params = bert.init_params(init_key, cfg)
     if getattr(args, "init_from", None):
         from pdnlp_tpu.train.pretrain import load_encoder

@@ -57,6 +57,7 @@ class Args:
 
     # --- TPU-native knobs (replace AMP / ZeRO / launcher flags) ---
     dtype: str = "float32"                        # "bfloat16" = the AMP analog
+    rng_impl: str = "rbg"                         # dropout PRNG (utils.seeding.train_key)
     strategy: str = "single"                      # single|pmap|dp|shardmap|zero|...
     remat: bool = False                           # activation checkpointing (ZeRO analog)
     attention_impl: str = "auto"                  # auto|xla|pallas
@@ -91,32 +92,45 @@ class Args:
                             name or self.ckpt_name or f"{self.strategy}-cls.msgpack")
 
 
-def parse_cli(argv=None, base: Optional[Args] = None) -> Args:
-    """``--key value`` CLI overrides onto an ``Args`` (argparse analog of
-    ``multi-gpu-distributed-cls.py:374-381``)."""
-    import argparse
-
+def add_dataclass_args(parser, cls, defaults=None) -> None:
+    """Add one typed ``--field`` per dataclass field: Optional[T] unwraps to
+    T, bools accept 1/true/yes, and structured fields (dicts/lists) parse as
+    JSON — loud failure on malformed input beats silent str-typing.  Shared
+    by ``parse_cli`` (Args) and the AutoTrainer entrypoint (TrainerArgs)."""
     import types
     import typing
 
-    base = base or Args()
-    p = argparse.ArgumentParser()
-    hints = typing.get_type_hints(Args)
-    for f in dataclasses.fields(Args):
-        default = getattr(base, f.name)
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        if defaults is not None:
+            default = getattr(defaults, f.name)
+        elif f.default is not dataclasses.MISSING:
+            default = f.default
+        elif f.default_factory is not dataclasses.MISSING:
+            default = f.default_factory()
+        else:
+            default = None  # required field: argparse surfaces the miss
         hint = hints.get(f.name, str)
         # Unwrap Optional[T] so `--num_processes 4` parses as int, not "4".
         if typing.get_origin(hint) in (typing.Union, types.UnionType):
             inner = [a for a in typing.get_args(hint) if a is not type(None)]
             hint = inner[0] if len(inner) == 1 else str
         if hint is bool:
-            p.add_argument(f"--{f.name}", type=lambda s: s.lower() in ("1", "true", "yes"),
-                           default=default)
+            parser.add_argument(f"--{f.name}",
+                                type=lambda s: s.lower() in ("1", "true", "yes"),
+                                default=default)
         elif hint in (int, float, str):
-            p.add_argument(f"--{f.name}", type=hint, default=default)
+            parser.add_argument(f"--{f.name}", type=hint, default=default)
         else:
-            # dicts/lists and any future structured field parse as JSON —
-            # loud failure on malformed input beats silent str-typing.
-            p.add_argument(f"--{f.name}", type=json.loads, default=default)
+            parser.add_argument(f"--{f.name}", type=json.loads, default=default)
+
+
+def parse_cli(argv=None, base: Optional[Args] = None) -> Args:
+    """``--key value`` CLI overrides onto an ``Args`` (argparse analog of
+    ``multi-gpu-distributed-cls.py:374-381``)."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    add_dataclass_args(p, Args, defaults=base or Args())
     ns = p.parse_args(argv)
     return Args(**vars(ns))

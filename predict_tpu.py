@@ -54,8 +54,14 @@ def main(args: Args, text=None, true_label=None):
 
     preds = {}
     for path in discover_checkpoints(args.output_dir):
-        name = os.path.basename(path)
-        params = jax.device_put(ckpt.load_params(path, state["params"]))
+        name = os.path.relpath(path, args.output_dir)
+        try:
+            loaded = ckpt.load_params(path, state["params"])
+        except Exception as e:  # e.g. a checkpoint from a different --model
+            rank0_print(f"{name}  skipped (incompatible with --model "
+                        f"{args.model}): {type(e).__name__}: {e}")
+            continue
+        params = jax.device_put(loaded)
         pred = int(np.argmax(np.asarray(forward(params, batch)[0])))
         preds[name] = pred
         true_s = id2label.get(true_label, "?") if true_label is not None else "?"

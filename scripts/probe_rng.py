@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Measure dropout RNG cost: threefry vs rbg keys for the train step."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "output/xla_cache")
+
+from pdnlp_tpu.models import bert, get_config
+from pdnlp_tpu.train.optim import build_optimizer
+from pdnlp_tpu.train.steps import build_train_step, init_state
+from pdnlp_tpu.utils.config import Args
+
+N = 50
+B, S = 32, 128
+
+args = Args(strategy="dp", dtype="bfloat16")
+cfg = get_config(args.model, vocab_size=16000, num_labels=6,
+                 dropout=args.dropout, attn_dropout=args.attn_dropout)
+key = jax.random.PRNGKey(0)
+params = bert.init_params(key, cfg)
+tx = build_optimizer(params, args)
+batch = jax.device_put({
+    "input_ids": jnp.ones((B, S), jnp.int32),
+    "token_type_ids": jnp.zeros((B, S), jnp.int32),
+    "attention_mask": jnp.ones((B, S), jnp.int32),
+    "label": jnp.zeros((B,), jnp.int32),
+    "example_weight": jnp.ones((B,), jnp.float32),
+})
+
+
+def timeit(name, fn):
+    out = fn()
+    jax.block_until_ready(out)
+    float(jnp.sum(out).astype(jnp.float32))
+    t0 = time.time()
+    for _ in range(N):
+        out = fn()
+    float(jnp.sum(out).astype(jnp.float32))
+    print(f"{name:30s}: {(time.time()-t0)/N*1e3:7.2f} ms")
+
+
+step = jax.jit(build_train_step(cfg, tx, args))
+for impl in ("threefry2x32", "rbg", "unsafe_rbg"):
+    state = init_state(key, cfg, tx, rng=jax.random.key(0, impl=impl),
+                       params=params)
+    try:
+        timeit(f"full step rng={impl}", lambda: step(state, batch)[1]["loss"])
+    except Exception as e:
+        print(f"{impl}: FAILED {type(e).__name__}: {e}")

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Run every strategy entrypoint once and collect the per-strategy table —
+the analog of the reference's headline README table (reference README.md:10-20)
+and of its all-checkpoints test.py/predict.py ritual (test.py:85-94).
+
+Each row fine-tunes from the in-repo pretrain checkpoint (the reference's
+rows all start from pretrained hfl/chinese-bert-wwm-ext).  Writes
+output/matrix.json and prints a markdown table.
+
+    python scripts/run_matrix.py [--skip-pretrain-check]
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CKPT = "output/pretrained.msgpack"
+
+# (name, argv, env overrides, expected checkpoint)
+RUNS = [
+    ("single", [sys.executable, "single-tpu-cls.py",
+                "--init_from", CKPT], {}, "output/single-cls.msgpack"),
+    ("dataparallel", [sys.executable, "multi-tpu-dataparallel-cls.py",
+                      "--init_from", CKPT], {}, "output/dataparallel-cls.msgpack"),
+    ("dp (DDP analog)", [sys.executable, "multi-tpu-jax-cls.py",
+                         "--init_from", CKPT], {}, "output/dp-cls.msgpack"),
+    ("amp (bf16)", [sys.executable, "multi-tpu-amp-cls.py",
+                    "--init_from", CKPT], {}, "output/amp-cls.msgpack"),
+    ("shardmap (Horovod analog)", [sys.executable, "multi-tpu-shardmap-cls.py",
+                                   "--init_from", CKPT], {},
+     "output/shardmap-cls.msgpack"),
+    ("zero (ZeRO-3 analog)", [sys.executable, "multi-tpu-zero-cls.py",
+                              "--init_from", CKPT], {}, "output/zero-cls.msgpack"),
+    ("accelerate", [sys.executable, "multi-tpu-accelerate-cls.py",
+                    "--init_from", CKPT], {}, "output/accelerate-cls.msgpack"),
+    ("trainer (HF Trainer analog)", [sys.executable, "multi-tpu-trainer-cls.py",
+                                     "--bf16", "true", "--init_from", CKPT], {},
+     None),
+    # the spawn launcher forks real processes; on the one-chip image it runs
+    # on the CPU backend with 2 processes x 4 virtual devices (the same
+    # configuration the spawn execution test pins)
+    ("spawn 2-proc (CPU backend)",
+     [sys.executable, "multi-tpu-spawn-cls.py", "--num_processes", "2",
+      "--init_from", CKPT, "--data_limit", "2000", "--ckpt_name",
+      "spawn-cls.msgpack"],
+     {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+     "output/spawn-cls.msgpack"),
+]
+
+RE_MIN = re.compile(r"耗时：([\d.]+)分钟")
+RE_ACC = re.compile(r"accuracy：([\d.]+)")
+RE_EVAL_ACC = re.compile(r"eval_accuracy ([\d.]+)")
+RE_RUNTIME = re.compile(r"'train_runtime': ([\d.]+)")
+
+
+def main() -> None:
+    os.chdir(ROOT)
+    if not os.path.exists(CKPT):
+        sys.exit(f"{CKPT} missing — run pretrain-tpu.py first")
+    results = {}
+    for name, argv, env_over, ckpt_path in RUNS:
+        env = dict(os.environ, **env_over)
+        print(f"=== {name}: {' '.join(argv[1:])}", flush=True)
+        p = subprocess.run(argv, env=env, capture_output=True, text=True,
+                           timeout=3000)
+        out = p.stdout + p.stderr
+        if p.returncode != 0:
+            print(out[-3000:])
+            results[name] = {"error": p.returncode}
+            continue
+        minutes = RE_MIN.findall(out)
+        accs = RE_ACC.findall(out)
+        eval_accs = RE_EVAL_ACC.findall(out)
+        runtime = RE_RUNTIME.findall(out)
+        row = {
+            "minutes": float(minutes[-1]) if minutes else (
+                round(float(runtime[-1]) / 60, 4) if runtime else None),
+            "accuracy": float(accs[-1]) if accs else (
+                float(eval_accs[-1]) if eval_accs else None),
+            "checkpoint": ckpt_path if ckpt_path and os.path.exists(ckpt_path)
+            else ("missing!" if ckpt_path else "output/auto/checkpoint-*"),
+        }
+        results[name] = row
+        print(f"    -> {row}", flush=True)
+    with open("output/matrix.json", "w") as f:
+        json.dump(results, f, indent=2, ensure_ascii=False)
+    print("\n| Strategy | min/epoch (incl. compile) | dev accuracy |")
+    print("|---|---|---|")
+    for name, row in results.items():
+        if "error" in row:
+            print(f"| {name} | FAILED | — |")
+        else:
+            print(f"| {name} | {row['minutes']} | {row['accuracy']} |")
+
+
+if __name__ == "__main__":
+    main()

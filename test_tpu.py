@@ -31,9 +31,15 @@ from pdnlp_tpu.utils.metrics import classification_report
 
 def discover_checkpoints(output_dir: str):
     """Every strategy checkpoint, sorted by name (the ``models`` dict sweep,
-    ``test.py:85-94``)."""
+    ``test.py:85-94``).  Recurses one managed-run layout deep so
+    ``AutoTrainer``'s ``auto/checkpoint-<step>/model.msgpack`` rotation dirs
+    are swept too; ``pretrained.msgpack`` is an MLM-stage artifact (encoder +
+    head, no classifier), not a strategy checkpoint, and is excluded."""
     return sorted(glob.glob(os.path.join(output_dir, "*-cls.msgpack"))
-                  + glob.glob(os.path.join(output_dir, "model.msgpack")))
+                  + glob.glob(os.path.join(output_dir, "model.msgpack"))
+                  + glob.glob(os.path.join(output_dir, "*", "model.msgpack"))
+                  + glob.glob(os.path.join(output_dir, "*", "checkpoint-*",
+                                           "model.msgpack")))
 
 
 def main(args: Args) -> dict:
@@ -47,7 +53,7 @@ def main(args: Args) -> dict:
         return {}
     results = {}
     for path in paths:
-        name = os.path.basename(path)
+        name = os.path.relpath(path, args.output_dir)
         rank0_print(f"\n======== {name} ========")
         try:
             loaded = ckpt.load_params(path, state["params"])

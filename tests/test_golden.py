@@ -27,6 +27,7 @@ def test_golden_loss_trace(ndev):
     args = Args(model=c["model"], max_seq_len=c["max_seq_len"],
                 train_batch_size=c["train_batch_size"],
                 data_limit=c["data_limit"], dtype=c["dtype"], seed=c["seed"],
+                rng_impl=c.get("rng_impl", "threefry2x32"),
                 log_every=10 ** 9)
     trainer, loader, _ = build_parallel_trainer(args, mode="dp")
     losses, epoch = [], 0
