@@ -26,9 +26,7 @@ from pdnlp_tpu.utils.config import Args, parse_cli
 _PORT = 12355  # the tcp://localhost:12345 analog (different port: CI safety)
 
 
-def spawn(args) -> int:
-    """Fork ``num_processes`` copies of this script with PROCESS_ID set
-    (the ``mp.spawn(main_worker, nprocs=N)`` analog)."""
+def _launch_gang(args, extra_argv) -> list:
     procs = []
     for pid in range(args.num_processes):
         env = dict(os.environ)
@@ -37,12 +35,72 @@ def spawn(args) -> int:
             NUM_PROCESSES=str(args.num_processes),
             PROCESS_ID=str(pid),
         )
-        procs.append(subprocess.Popen([sys.executable, __file__, *sys.argv[1:]],
-                                      env=env))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+        procs.append(subprocess.Popen(
+            [sys.executable, __file__, *sys.argv[1:], *extra_argv], env=env))
+    return procs
+
+
+def spawn(args) -> int:
+    """Fork ``num_processes`` copies of this script with PROCESS_ID set
+    (the ``mp.spawn(main_worker, nprocs=N)`` analog).
+
+    With ``--elastic true`` the parent is also a failure detector (the
+    capability the reference entirely lacks — a dead rank leaves its NCCL
+    peers hung forever): workers heartbeat and snapshot full train state
+    every ``--resume_every`` steps; if any child crashes or the stalest
+    heartbeat exceeds ``--stall_timeout``, the parent kills the WHOLE gang
+    (SPMD collectives cannot absorb a lone replacement rank) and relaunches
+    it from the newest snapshot — a bitwise continuation, since resume
+    restores params + Adam moments + step + RNG and the data order is a
+    seeded permutation (``tests/test_resume.py``).
+    """
+    if not args.elastic:
+        procs = _launch_gang(args, [])
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+
+    import shutil
+    import time
+
+    from pdnlp_tpu.parallel.watchdog import GangMonitor, heartbeat_dir
+
+    # A previous run's AUTO snapshot would make fresh workers "resume" at
+    # its final step and train nothing — elastic state is per-run.  A
+    # user-supplied --resume_from is the opposite intent (continue THAT
+    # run) and is left strictly alone.
+    if not args.resume_from or args.resume_from == "auto":
+        for stale in (args.resume_path(), args.resume_path() + "-best",
+                      args.resume_path() + "-best.json"):
+            if os.path.exists(stale):
+                os.remove(stale)
+    shutil.rmtree(heartbeat_dir(args.output_dir), ignore_errors=True)
+
+    worker_argv = ["--heartbeat_interval",
+                   str(args.heartbeat_interval or 2.0),
+                   "--resume_every", str(args.resume_every or 10)]
+    if not args.resume_from:
+        worker_argv += ["--resume_from", "auto"]
+    restarts = 0
+    while True:
+        procs = _launch_gang(args, worker_argv)
+        mon = GangMonitor(procs, args.output_dir, args.num_processes,
+                          stall_timeout=args.stall_timeout)
+        verdict = None
+        while verdict is None:
+            time.sleep(0.2)
+            verdict = mon.poll()
+        if verdict["kind"] == "done":
+            return 0
+        mon.kill_gang()
+        if restarts >= args.max_restarts:
+            print(f"[elastic] giving up after {restarts} restarts: {verdict}",
+                  file=sys.stderr)
+            return 1
+        restarts += 1
+        print(f"[elastic] gang failure {verdict} — restart {restarts}/"
+              f"{args.max_restarts} from latest snapshot", file=sys.stderr)
 
 
 def main() -> int:

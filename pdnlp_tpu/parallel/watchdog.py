@@ -1,0 +1,121 @@
+"""Failure detection + elastic restart for multi-process gangs.
+
+The reference has no failure handling at all (``SURVEY.md`` §5): a dead rank
+leaves the others blocked in NCCL collectives forever.  The TPU-native
+failure mode is identical — XLA collectives over a shared coordinator hang
+when a peer dies — so detection must happen at the HOST level, outside the
+device stream:
+
+- **Heartbeat** (worker side): each process touches a per-rank file at a
+  bounded rate from the training loop.  A wedged device queue, a deadlocked
+  collective, or a killed process all stop the beats.
+- **GangMonitor** (launcher side): polls child liveness and heartbeat
+  freshness; classifies the gang as ``crashed`` (a child exited nonzero) or
+  ``stalled`` (a heartbeat older than the timeout).
+- **Elastic restart** (launcher side, ``multi-tpu-spawn-cls.py``): on
+  failure the whole gang is killed and relaunched from the newest periodic
+  resume snapshot (``Trainer`` saves full state — params, Adam moments,
+  step, RNG — every ``--resume_every`` steps).  Because resume is *bitwise*
+  (``tests/test_resume.py``) and the data order is a seeded permutation, the
+  restarted run replays the lost steps exactly: a crash costs wall-clock,
+  never training math.
+
+Gang semantics (not per-rank restart): TPU meshes are SPMD — a lone
+replacement rank cannot rejoin compiled collectives — so the restart unit is
+the full gang, the same model cluster schedulers (GKE/Borg) use for TPU
+slices.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+
+def heartbeat_dir(output_dir: str) -> str:
+    return os.path.join(output_dir, "heartbeats")
+
+
+def heartbeat_file(output_dir: str, process_index: int) -> str:
+    return os.path.join(heartbeat_dir(output_dir), f"proc{process_index}")
+
+
+class Heartbeat:
+    """Rate-limited liveness beacon written from the training loop."""
+
+    def __init__(self, output_dir: str, process_index: int,
+                 interval: float = 5.0):
+        self.path = heartbeat_file(output_dir, process_index)
+        self.interval = interval
+        self._last = 0.0
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        # deliberately NO beat here: the first beat lands after the first
+        # completed step, so the monitor's pre-first-beat grace window (4x
+        # stall_timeout) covers rendezvous + XLA compile — an early beat
+        # would start the stall clock before compilation finishes
+
+    def beat(self, force: bool = False) -> None:
+        now = time.time()
+        if force or (now - self._last) >= self.interval:
+            self._last = now
+            with open(self.path, "w") as f:
+                f.write(str(now))
+
+
+class GangMonitor:
+    """Launcher-side failure detector over child processes + heartbeats."""
+
+    def __init__(self, procs: List, output_dir: str, num_processes: int,
+                 stall_timeout: float = 120.0):
+        self.procs = procs
+        self.output_dir = output_dir
+        self.num_processes = num_processes
+        self.stall_timeout = stall_timeout
+        self.started = time.time()
+
+    def _heartbeat_age(self) -> Optional[float]:
+        """Age in seconds of the STALEST rank heartbeat (None before all
+        ranks have beaten).  Files older than this monitor's start are
+        leftovers from a previous incarnation, not beats."""
+        ages = []
+        for i in range(self.num_processes):
+            p = heartbeat_file(self.output_dir, i)
+            try:
+                mtime = os.path.getmtime(p)
+            except OSError:
+                return None  # not all ranks beating yet — grace period
+            if mtime < self.started:
+                return None
+            ages.append(time.time() - mtime)
+        return max(ages) if ages else None
+
+    def poll(self) -> Optional[Dict]:
+        """None while healthy; otherwise a verdict dict:
+        ``{"kind": "crashed"|"stalled", ...}``.  ``kind`` is None-equivalent
+        ("done") when every child exited 0."""
+        codes = [p.poll() for p in self.procs]
+        if any(c is not None and c != 0 for c in codes):
+            return {"kind": "crashed",
+                    "codes": codes}
+        if all(c == 0 for c in codes):
+            return {"kind": "done", "codes": codes}
+        age = self._heartbeat_age()
+        if age is not None and age > self.stall_timeout:
+            return {"kind": "stalled", "stalest_beat_s": round(age, 1),
+                    "codes": codes}
+        # also treat "no rank ever beat within the timeout" (e.g. rendezvous
+        # deadlock at startup) as a stall
+        if age is None and (time.time() - self.started) > 4 * self.stall_timeout:
+            return {"kind": "stalled", "stalest_beat_s": None, "codes": codes}
+        return None
+
+    def kill_gang(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in self.procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()

@@ -237,6 +237,12 @@ def run_pretrain(args) -> str:
             rank0_print(f"[pretrain] epoch {epoch}/{args.epochs} "
                         f"loss {float(last['loss']):.4f} "
                         f"mask_acc {float(last['mask_acc']):.4f}")
+        if args.pretrain_ckpt_every and epoch % args.pretrain_ckpt_every == 0 \
+                and epoch != args.epochs:
+            # epoch-curve checkpoints: lets an accuracy-vs-pretrain-compute
+            # sweep fine-tune from several depths of ONE run
+            ckpt.save_params(
+                args.ckpt_path(f"pretrained-e{epoch}.msgpack"), state)
     if last is not None:
         float(jax.device_get(last["loss"]))  # completion barrier
     minutes = (time.time() - start) / 60

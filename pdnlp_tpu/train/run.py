@@ -77,7 +77,14 @@ def build_parallel_trainer(
 
 def run_parallel(args: Args, **strategy) -> float:
     """Train + test; returns wall-clock minutes (the north-star metric)."""
+    import os
+
     trainer, train_loader, dev_loader = build_parallel_trainer(args, **strategy)
+    if args.resume_from and os.path.exists(args.resume_path()):
+        # elastic restart path: continue bitwise from the latest snapshot
+        trainer.load_resume(args.resume_path())
+        rank0_print(f"resumed from {args.resume_path()} at step "
+                    f"{int(jax.device_get(trainer.state['step']))}")
     minutes = trainer.train(train_loader, dev_loader)
     result = trainer.test(dev_loader)
     rank0_print(f"test loss：{result['loss']:.6f} accuracy：{result['accuracy']:.4f}")

@@ -18,6 +18,7 @@ reference instead syncs every step (`.item()` after an explicit barrier).
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Dict, Optional, Tuple
 
@@ -54,6 +55,7 @@ class Trainer:
         self.multi_step = multi_step
         self.put_fused = put_fused or self.put
         self.best_accuracy = 0.0
+        self._best_params = None  # device-held copy; written once at end
 
     def _macro_batches(self, loader, k: int):
         """Yield (batch, n_steps, fused): groups of ``k`` host batches
@@ -74,19 +76,50 @@ class Trainer:
 
     # ------------------------------------------------------------------ train
     def train(self, train_loader, dev_loader=None) -> float:
-        """Run ``args.epochs`` epochs; returns wall-clock minutes."""
+        """Run ``args.epochs`` epochs; returns wall-clock minutes.
+
+        Elastic hooks (all off by default):  a state restored via
+        ``load_resume`` fast-forwards the seeded data order to its step
+        counter and continues bitwise; ``args.resume_every`` snapshots full
+        state every N steps; ``args.heartbeat_interval`` beats a liveness
+        file for the launcher-side ``GangMonitor``.
+        """
         args = self.args
         total_step = len(train_loader) * args.epochs
         gstep = 0
+        # fast-forward: a restored state carries the step it was saved at;
+        # the sampler is a seeded permutation, so skipping exactly that many
+        # batches replays the identical remaining stream (bitwise resume)
+        start_step = int(jax.device_get(self.state["step"]))
         pending: Tuple[int, int, jax.Array] | None = None  # (epoch, gstep, loss)
         last_loss = None
         profiler = Profiler(getattr(args, "profile_dir", None))
         fuse = getattr(args, "fuse_steps", 1)
+        resume_every = getattr(args, "resume_every", None)
+        heartbeat = None
+        if getattr(args, "heartbeat_interval", 0) > 0:
+            from pdnlp_tpu.parallel.watchdog import Heartbeat
+
+            heartbeat = Heartbeat(args.output_dir, jax.process_index(),
+                                  args.heartbeat_interval)
+        # chaos hook for the elastic tests: PDNLP_FAULT_STEP kills rank
+        # PDNLP_FAULT_PROC at that step — but only on a fresh (non-resumed)
+        # incarnation, so the restarted gang survives
+        fault_step = int(os.environ.get("PDNLP_FAULT_STEP", "0"))
+        fault_proc = int(os.environ.get("PDNLP_FAULT_PROC", "0"))
         examples = 0
         start = time.time()
         for epoch in range(1, args.epochs + 1):
             train_loader.set_epoch(epoch - 1)
             for batch, n, fused in self._macro_batches(train_loader, fuse):
+                if gstep + n <= start_step:  # already done before the restart
+                    gstep += n
+                    if heartbeat is not None:  # long fast-forwards stay live
+                        heartbeat.beat()
+                    continue
+                if fault_step and start_step == 0 and gstep >= fault_step \
+                        and jax.process_index() == fault_proc:
+                    os._exit(13)
                 if fused:
                     self.state, metrics = self.multi_step(
                         self.state, self.put_fused(batch))
@@ -98,6 +131,10 @@ class Trainer:
                 gstep += n
                 examples += int(batch["example_weight"].sum())
                 profiler.step(gstep)
+                if heartbeat is not None:
+                    heartbeat.beat()
+                if resume_every and gstep // resume_every != prev // resume_every:
+                    self.save_resume(args.resume_path())
                 if gstep // args.log_every != prev // args.log_every:
                     if pending is not None:  # print the *previous* line's loss:
                         e, s, l = pending     # it is done by now — no sync stall
@@ -123,14 +160,26 @@ class Trainer:
         rank0_print(StepStats(gstep, examples, minutes).line())
         if not args.dev:
             self._save(args.ckpt_path())
+        elif self._best_params is not None:
+            # adopt + persist the best-of-epoch params (the reference's
+            # best-checkpoint ritual; its test.py then evaluates that file)
+            self.state["params"] = self._best_params
+            ckpt.save_params(args.ckpt_path(), {"params": self._best_params})
         return minutes
 
     def _dev_and_maybe_save(self, dev_loader) -> None:
+        """Eval; keep the best params (the reference checkpoints to disk on
+        every improvement INSIDE the timed loop, ``multi-gpu-distributed-
+        cls.py:183-192`` — here the best copy stays in HBM and one write
+        happens after training, same end state without serializing the epoch
+        behind checkpoint I/O)."""
         loss, acc = self.dev(dev_loader)
         rank0_print(fmt_dev(loss, acc))
         if acc > self.best_accuracy:
             self.best_accuracy = acc
-            self._save(self.args.ckpt_path())
+            # jnp.copy: the live params are donated buffers; the copy is ours
+            self._best_params = jax.tree_util.tree_map(
+                jax.numpy.copy, self.state["params"])
             rank0_print(fmt_best(acc))
 
     def _save(self, path: str) -> None:
@@ -141,12 +190,30 @@ class Trainer:
     def save_resume(self, path: str) -> None:
         """Full mid-training snapshot: params + optimizer moments + step +
         RNG.  The reference cannot resume (``SURVEY.md`` §5: no optimizer
-        state saving anywhere); this framework can, bitwise."""
+        state saving anywhere); this framework can, bitwise.
+
+        The best-of-epoch tracker rides along in sidecar files (``<path>``
+        + ``-best``/``-best.json``) so an elastic restart cannot regress the
+        shipped best model to a later, worse eval."""
         ckpt.save_state(path, self.state)
+        if self._best_params is not None:
+            ckpt.save_params(path + "-best", {"params": self._best_params})
+            if jax.process_index() == 0:
+                import json
+
+                with open(path + "-best.json", "w") as f:
+                    json.dump({"best_accuracy": self.best_accuracy}, f)
 
     def load_resume(self, path: str) -> None:
         restored = ckpt.load_state(path, self.state)
-        self.state = jax.device_put(restored, _shardings_of(self.state))
+        self.state = _put_like(restored, self.state)
+        if os.path.exists(path + "-best"):
+            best = ckpt.load_params(path + "-best", self.state["params"])
+            self._best_params = _put_like(best, self.state["params"])
+            with open(path + "-best.json") as f:
+                import json
+
+                self.best_accuracy = json.load(f)["best_accuracy"]
 
     # ------------------------------------------------------------------- eval
     def _evaluate(self, loader, collect_preds: bool) -> Dict:
@@ -186,3 +253,30 @@ def _shardings_of(state):
     arrays exactly where the originals lived — replicated or ZeRO-sharded)."""
     return jax.tree_util.tree_map(
         lambda x: x.sharding if isinstance(x, jax.Array) else None, state)
+
+
+def _put_like(host_tree, live_tree):
+    """Place a restored host tree onto the live tree's shardings.
+
+    Single-process shardings are fully addressable and go through
+    ``device_put``.  Multi-process shardings span other hosts' devices, which
+    plain ``device_put`` refuses — every process read the same snapshot, so
+    each materializes its own addressable shards of the global array
+    (``make_array_from_callback`` slices the host copy per shard)."""
+    shardings = _shardings_of(live_tree)
+    if all(getattr(s, "is_fully_addressable", True)
+           for s in jax.tree_util.tree_leaves(shardings)):
+        return jax.device_put(host_tree, shardings)
+
+    def put(x, sh):
+        if jax.dtypes.issubdtype(getattr(x, "dtype", np.float32),
+                                 jax.dtypes.prng_key):
+            data = np.asarray(jax.random.key_data(x))
+            g = jax.make_array_from_callback(
+                data.shape, sh, lambda idx: data[idx])
+            return jax.random.wrap_key_data(g, impl=jax.random.key_impl(x))
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sh, lambda idx: arr[idx])
+
+    return jax.tree_util.tree_map(put, host_tree, shardings)

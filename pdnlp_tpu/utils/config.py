@@ -39,6 +39,7 @@ class Args:
     mlm_prob: float = 0.15                        # pretraining mask rate
     mlm_span: bool = True                         # n-gram (wwm-analog) masking
     pretrain_limit: Optional[int] = None          # cap pretrain texts (tests)
+    pretrain_ckpt_every: Optional[int] = None     # epoch-curve checkpoints
 
     # --- optimization (single-gpu-cls.py:86-97,193-205) ---
     learning_rate: float = 3e-5
@@ -73,6 +74,16 @@ class Args:
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
 
+    # --- failure detection / elastic restart (parallel/watchdog.py) ---
+    resume_every: Optional[int] = None            # full-state snapshot every N steps
+    resume_from: Optional[str] = None             # snapshot path, or "auto"
+    heartbeat_interval: float = 0.0               # seconds; 0 = no heartbeat
+    elastic: bool = False                         # spawn launcher: restart on failure
+    stall_timeout: float = 300.0                  # launcher stall detector
+                                                  # (pre-first-beat grace is
+                                                  # 4x this, covering compile)
+    max_restarts: int = 2                         # gang restarts before giving up
+
     def replace(self, **kw) -> "Args":
         return dataclasses.replace(self, **kw)
 
@@ -90,6 +101,12 @@ class Args:
         ``*.pt`` files that ``test.py:85-94`` sweeps."""
         return os.path.join(self.output_dir,
                             name or self.ckpt_name or f"{self.strategy}-cls.msgpack")
+
+    def resume_path(self) -> str:
+        """Where periodic full-state snapshots live (``resume_from="auto"``)."""
+        if self.resume_from and self.resume_from != "auto":
+            return self.resume_from
+        return os.path.join(self.output_dir, f"resume-{self.strategy}.msgpack")
 
 
 def add_dataclass_args(parser, cls, defaults=None) -> None:

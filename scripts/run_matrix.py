@@ -63,8 +63,13 @@ def main() -> None:
     for name, argv, env_over, ckpt_path in RUNS:
         env = dict(os.environ, **env_over)
         print(f"=== {name}: {' '.join(argv[1:])}", flush=True)
-        p = subprocess.run(argv, env=env, capture_output=True, text=True,
-                           timeout=3000)
+        try:
+            p = subprocess.run(argv, env=env, capture_output=True, text=True,
+                               timeout=3000)
+        except subprocess.TimeoutExpired:
+            print("    -> TIMEOUT", flush=True)
+            results[name] = {"error": "timeout"}
+            continue
         out = p.stdout + p.stderr
         if p.returncode != 0:
             print(out[-3000:])

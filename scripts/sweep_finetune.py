@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Fine-tune recipe sweep: lr x pretrain-checkpoint -> dev accuracy."""
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import itertools
+import re
+
+CKPTS = [c for c in ("output/pretrained.msgpack", "output/pretrained_r150.msgpack")
+         if os.path.exists(c)]
+LRS = ["2e-5", "3e-5", "5e-5"]
+
+for ckpt, lr in itertools.product(CKPTS, LRS):
+    p = subprocess.run(
+        [sys.executable, "multi-tpu-jax-cls.py", "--dtype", "bfloat16",
+         "--init_from", ckpt, "--learning_rate", lr,
+         "--log_every", "1000000000", "--dev", "false",
+         "--ckpt_name", "sweep-tmp.msgpack"],
+        capture_output=True, text=True, timeout=600)
+    accs = re.findall(r"accuracy：([\d.]+)", p.stdout)
+    mins = re.findall(r"耗时：([\d.]+)", p.stdout)
+    print(f"{os.path.basename(ckpt):28s} lr={lr:6s} "
+          f"acc={accs[-1] if accs else 'FAIL'} min={mins[-1] if mins else '?'}",
+          flush=True)
+    if not accs:
+        print(p.stdout[-1500:], p.stderr[-1500:])

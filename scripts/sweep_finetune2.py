@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Accuracy-vs-pretrain-compute sweep under the best-of-epoch protocol."""
+import os
+import re
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CKPTS = [
+    ("p30-e50", "output/pretrained-e50.msgpack"),
+    ("p30-e100", "output/pretrained-e100.msgpack"),
+    ("p30-e150", "output/pretrained_p30.msgpack"),
+    ("p15-e150", "output/pretrained_r150.msgpack"),
+    ("p15-e300", "output/pretrained.msgpack"),
+]
+
+for name, ckpt in CKPTS:
+    if not os.path.exists(ckpt):
+        continue
+    p = subprocess.run(
+        [sys.executable, "multi-tpu-jax-cls.py", "--dtype", "bfloat16",
+         "--init_from", ckpt, "--dev", "true", "--eval_step", "50",
+         "--log_every", "1000000000", "--ckpt_name", "sweep-tmp.msgpack"],
+        capture_output=True, text=True, timeout=600)
+    best = re.findall(r"【best accuracy】 ([\d.]+)", p.stdout)
+    final = re.findall(r"accuracy：([\d.]+)", p.stdout)
+    print(f"{name:10s} best={best[-1] if best else 'FAIL'} "
+          f"final_test={final[-1] if final else '?'}", flush=True)
+    if not best:
+        print(p.stdout[-1200:], p.stderr[-1200:])

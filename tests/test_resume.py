@@ -58,6 +58,25 @@ def test_resume_is_bitwise(tmp_path):
     assert int(resumed["step"]) == 4
 
 
+def test_resume_cross_rng_impl_is_loud(tmp_path):
+    """A resume checkpoint saved under one --rng_impl loaded under another
+    must raise the targeted error (not a confusing shape complaint): rbg
+    key_data is [4]u32, threefry [2]u32."""
+    batches = [fake_batch(8, seed=i) for i in range(1)]
+    args = tiny_args(rng_impl="rbg")
+    cfg, tx, state = setup_model(args, VOCAB)
+    state, _ = run_steps(state, make_train_step(cfg, tx, args), batches)
+    t = Trainer(args, cfg, state, None, eval_step=None)
+    path = str(tmp_path / "rbg.msgpack")
+    t.save_resume(path)
+
+    args2 = tiny_args(rng_impl="threefry2x32")
+    cfg2, tx2, state2 = setup_model(args2, VOCAB)
+    t2 = Trainer(args2, cfg2, state2, None, eval_step=None)
+    with pytest.raises(ValueError, match="--rng_impl"):
+        t2.load_resume(path)
+
+
 def test_resume_preserves_sharding(tmp_path, ndev):
     """A ZeRO-sharded state restores onto its original shardings."""
     from pdnlp_tpu.parallel import (
