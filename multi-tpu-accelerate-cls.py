@@ -27,9 +27,15 @@ from pdnlp_tpu.utils.metrics import classification_report
 def main(args: Args) -> float:
     accelerator = Accelerator(args)
 
-    # user-style single-device setup (the reference's main() body)
+    # user-style single-device setup (the reference's main() body).
+    # total_steps for the LR schedule must reflect the POST-prepare() loader:
+    # prepare scales batches by accelerator.batch_mult, shrinking the step
+    # count (the same division the reference highlights at :145,271).
     train_loader, dev_loader, tok = setup_data(args)
-    cfg, tx, state = setup_model(args, tok.vocab_size)
+    global_batch = args.train_batch_size * accelerator.batch_mult
+    steps_per_epoch = -(-len(train_loader.sampler) // global_batch)
+    cfg, tx, state = setup_model(args, tok.vocab_size,
+                                 total_steps=steps_per_epoch * args.epochs)
 
     # the one distributed-awareness step
     state, train_loader, dev_loader = accelerator.prepare(

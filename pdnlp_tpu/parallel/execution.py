@@ -36,12 +36,21 @@ from pdnlp_tpu.train.steps import (
 from pdnlp_tpu.utils.seeding import set_seed
 
 
-def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp"
+def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
+                        total_steps: int = None
                         ) -> Tuple[BertConfig, optax.GradientTransformation, State, Any]:
-    """(cfg, tx, state, shardings) — state lives on the mesh from birth."""
+    """(cfg, tx, state, shardings) — state lives on the mesh from birth.
+
+    ``total_steps`` sizes the optional LR schedule (``--lr_schedule``);
+    required when one is configured."""
+    from pdnlp_tpu.train.optim import make_schedule
+    from pdnlp_tpu.utils.seeding import train_key
+
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
                      dropout=args.dropout, attn_dropout=args.attn_dropout)
-    from pdnlp_tpu.utils.seeding import train_key
+    if getattr(args, "lr_schedule", None) and total_steps is None:
+        raise ValueError("--lr_schedule needs total_steps (pass the loader "
+                         "length x epochs to setup_sharded_model)")
 
     root = set_seed(args.seed)
     init_key, _ = jax.random.split(root)
@@ -49,7 +58,9 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp"
 
     # tx needs a params *structure* for the weight-decay mask — shapes only.
     param_shapes = jax.eval_shape(lambda k: bert.init_params(k, cfg), init_key)
-    tx = build_optimizer(param_shapes, args)
+    tx = build_optimizer(param_shapes, args,
+                         schedule=make_schedule(args, total_steps)
+                         if total_steps else None)
 
     def init_fn(key, rng):
         params = bert.init_params(key, cfg)
@@ -125,14 +136,18 @@ def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
     combined weighted by their local weight mass, which reproduces the
     global-mean gradient exactly even when filler rows make shards uneven.
     """
+    from pdnlp_tpu.train.steps import _unroll
+
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
     compress = jnp.bfloat16 if compress_grads else None
+    unroll = _unroll(args)
 
     def local_loss(params, batch, rng):
         logits = bert.classify(params, cfg, batch, dtype=dtype, deterministic=False,
-                               rng=rng, remat=remat, attn_impl=attn_impl)
+                               rng=rng, remat=remat, attn_impl=attn_impl,
+                               unroll=unroll)
         loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"])
         return loss, (correct, batch["example_weight"].sum())
 

@@ -47,8 +47,11 @@ def make_sp_batch(mesh: Mesh) -> Callable[[Dict], Dict[str, jax.Array]]:
 def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
     """Fused sequence-parallel train step (state replicated, batch sharded
     over (data, seq)); same Trainer contract as every other strategy."""
+    from pdnlp_tpu.train.steps import _unroll
+
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
+    unroll = _unroll(args)
     if args.attn_dropout > 0:
         raise ValueError(
             "sequence-parallel training has no attention-probability dropout "
@@ -58,7 +61,7 @@ def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
     def local_loss(params, batch, rng):
         logits = bert.classify(params, cfg, batch, dtype=dtype,
                                deterministic=False, rng=rng, remat=remat,
-                               seq_axis=SEQ)
+                               seq_axis=SEQ, unroll=unroll)
         loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"])
         # gate to seq-shard 0: head grads counted once; encoder grads flow
         # to every shard through the psum backward (see module docstring)
@@ -112,11 +115,15 @@ def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
 def make_sp_eval_step(cfg: BertConfig, args, mesh: Mesh):
     """Deterministic sequence-parallel eval step (same metric contract as
     ``train.steps.build_eval_step``)."""
+    from pdnlp_tpu.train.steps import _unroll
+
     dtype = resolve_dtype(args.dtype)
+    unroll = _unroll(args)
 
     def per_device(params, batch):
         logits = bert.classify(params, cfg, batch, dtype=dtype,
-                               deterministic=True, seq_axis=SEQ)
+                               deterministic=True, seq_axis=SEQ,
+                               unroll=unroll)
         w = batch["example_weight"]
         loss, correct = weighted_ce(logits, batch["label"], w)
         wsum = w.sum()

@@ -63,6 +63,9 @@ class Accelerator:
         )
         self.put = make_global_batch(self.mesh)
         self.num_devices = self.mesh.size
+        # prepare() scales loader batches by this; anything sized in steps
+        # (LR schedules, total_step prints) must divide by it up front
+        self.batch_mult = local_batch_mult(self.mesh)
         self.process_index = jax.process_index()
         self.is_main_process = self.process_index == 0
         self._shardings = None

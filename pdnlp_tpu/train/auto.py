@@ -16,10 +16,12 @@ from __future__ import annotations
 import dataclasses
 import os
 import shutil
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from pdnlp_tpu.train import checkpoint as ckpt
@@ -95,6 +97,8 @@ class AutoTrainer:
         self.state_history: List[Tuple[int, str]] = []  # (step, ckpt_dir)
         self.best_metric: Optional[float] = None
         self.best_ckpt: Optional[str] = None
+        self._writers: List[threading.Thread] = []  # in-flight async saves
+        self._writer_errors: List[Tuple[str, BaseException]] = []
 
     # ---------------------------------------------------------------- train
     def train(self) -> Dict[str, float]:
@@ -118,6 +122,8 @@ class AutoTrainer:
                     self._save_checkpoint(gstep)
         if metrics is not None:
             float(jax.device_get(metrics["loss"]))  # completion barrier
+        self._drain_writers()   # all checkpoint files durable before reload
+        self._rotate()
         runtime = time.time() - start
         if targs.load_best_model_at_end and self.best_ckpt:
             path = os.path.join(self.best_ckpt, "model.msgpack")
@@ -159,12 +165,52 @@ class AutoTrainer:
         return os.path.join(self.targs.output_dir, f"checkpoint-{gstep}")
 
     def _save_checkpoint(self, gstep: int) -> None:
+        """Checkpoint WITHOUT stalling the device: snapshot params in HBM
+        (jnp.copy — the live buffers are donated), then fetch + serialize in
+        a writer thread that overlaps with continued training.  HF Trainer
+        blocks the step loop on every save; over a tunneled device transport
+        that serialization dominated the epoch (measured 4.3 min vs ~0.6 for
+        the other strategies at the reference's save_steps=50 cadence).
+
+        Multi-process runs save synchronously: ``consolidate`` runs
+        collective all-gathers, which must not race training collectives on
+        another thread."""
         d = self._ckpt_dir(gstep)
         if any(dir_ == d for _, dir_ in self.state_history):
             return  # already written this step (best-model save + save_steps)
-        # all processes enter (consolidate is collective); rank 0 writes
-        ckpt.save_params(os.path.join(d, "model.msgpack"), self._trainer.state)
+        path = os.path.join(d, "model.msgpack")
+        if jax.process_count() > 1:
+            ckpt.save_params(path, self._trainer.state)
+        else:
+            snap = jax.tree_util.tree_map(jnp.copy, self._trainer.state["params"])
+
+            def write(path=path, snap=snap):
+                try:
+                    ckpt.save_params(path, {"params": snap})
+                except BaseException as e:  # surfaced at the next drain
+                    self._writer_errors.append((path, e))
+
+            t = threading.Thread(target=write, daemon=True)
+            t.start()
+            self._writers.append(t)
         self.state_history.append((gstep, d))
+        # bound in-flight disk usage near the user's cap (a few extra dirs
+        # may exist transiently while writers overlap training)
+        if len(self.state_history) > (self.targs.save_total_limit or 16):
+            self._drain_writers()
+            self._rotate()
+
+    def _drain_writers(self) -> None:
+        for t in self._writers:
+            t.join()
+        self._writers.clear()
+        if self._writer_errors:
+            path, err = self._writer_errors[0]
+            self._writer_errors.clear()
+            raise RuntimeError(
+                f"async checkpoint write failed for {path}") from err
+
+    def _rotate(self) -> None:
         if jax.process_index() != 0:
             return
         limit = self.targs.save_total_limit

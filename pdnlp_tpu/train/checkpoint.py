@@ -31,15 +31,18 @@ def consolidate(tree):
     multi-process shards (some devices belong to other hosts) go through
     ``multihost_utils.process_allgather`` so every host sees the full value.
     """
-    def fetch(x):
-        if isinstance(x, jax.Array):
-            if not getattr(x, "is_fully_addressable", True):
-                from jax.experimental import multihost_utils
+    def gather(x):
+        if isinstance(x, jax.Array) and not getattr(x, "is_fully_addressable", True):
+            from jax.experimental import multihost_utils
 
-                return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-            return np.asarray(x)
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
         return x
-    return jax.tree_util.tree_map(fetch, tree)
+
+    gathered = jax.tree_util.tree_map(gather, tree)
+    # one batched transfer for everything still on device: device_get
+    # pipelines the copies, where per-leaf np.asarray round-trips the
+    # (possibly tunneled) transport once per leaf
+    return jax.device_get(gathered)
 
 
 def _wrap_rng(tree: Dict[str, Any]) -> Dict[str, Any]:

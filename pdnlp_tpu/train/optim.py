@@ -41,13 +41,34 @@ def decay_mask(params) -> object:
     return debias(params, masked)
 
 
+def make_schedule(args, total_steps: int):
+    """Learning-rate schedule from ``Args`` (``--lr_schedule``), or ``None``
+    for the reference's constant LR.  ``warmup_linear`` (the BERT-paper
+    recipe) measured best on the fine-tune sweep: +0.8 dev accuracy over
+    constant 3e-5 at peak 5e-5 (``scripts/sweep_recipe.py``)."""
+    if not getattr(args, "lr_schedule", None):
+        return None
+    w = max(1, int(total_steps * args.warmup_ratio))
+    if args.lr_schedule == "warmup_linear":
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, args.learning_rate, w),
+             optax.linear_schedule(args.learning_rate, 0.0, total_steps - w)],
+            [w])
+    if args.lr_schedule == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            0.0, args.learning_rate, w, total_steps)
+    raise ValueError(f"unknown lr_schedule {args.lr_schedule!r} "
+                     "(warmup_linear|warmup_cosine)")
+
+
 def build_optimizer(params, args, schedule=None) -> optax.GradientTransformation:
     """AdamW lr/b1/b2/eps/wd from ``Args`` (defaults mirror
     ``single-gpu-cls.py:86-97``: lr 3e-5, decay 0.01, no schedule).
 
-    ``schedule`` overrides the constant learning rate — used by the MLM
-    pretraining stage (warmup+decay), never by fine-tuning, which keeps the
-    reference's constant-lr semantics."""
+    ``schedule`` overrides the constant learning rate: the MLM pretraining
+    stage always passes one (warmup+decay), and fine-tune entrypoints pass
+    ``make_schedule(args, total_steps)`` when ``--lr_schedule`` is set
+    (constant LR — the reference's semantics — remains the default)."""
     return optax.adamw(
         learning_rate=schedule if schedule is not None else args.learning_rate,
         b1=args.adam_b1,

@@ -97,8 +97,11 @@ def build_mlm_step(cfg, tx, args, mask_id: int):
     """Fused MLM train step: corrupt -> encode(packed) -> tied head -> CE ->
     AdamW.  ``state['params']`` carries the encoder tree plus an ``'mlm'``
     subtree (head), stripped again at fine-tune load time."""
+    from pdnlp_tpu.train.steps import _unroll
+
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
+    unroll = _unroll(args)
 
     def loss_fn(params, batch, rng):
         k_mask, k_drop = jax.random.split(rng)
@@ -109,7 +112,7 @@ def build_mlm_step(cfg, tx, args, mask_id: int):
         hidden = bert.encode(
             params, cfg, ids, jnp.zeros_like(ids), (seg > 0).astype(jnp.int32),
             dtype=dtype, deterministic=False, rng=k_drop, remat=remat,
-            attn_bias=segment_bias(seg),
+            attn_bias=segment_bias(seg), unroll=unroll,
         )
         logits = bert.mlm_logits(params, params["mlm"], cfg, hidden, dtype=dtype)
         logp = jax.nn.log_softmax(logits)

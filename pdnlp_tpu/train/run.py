@@ -54,7 +54,9 @@ def build_parallel_trainer(
         shard_id=jax.process_index(),
         device_batch_mult=mult,
     )
-    cfg, tx, state, shardings = setup_sharded_model(args, tok.vocab_size, mesh, mode)
+    cfg, tx, state, shardings = setup_sharded_model(
+        args, tok.vocab_size, mesh, mode,
+        total_steps=len(train_loader) * args.epochs)
     if explicit_collectives:
         train_step = make_shardmap_train_step(cfg, tx, args, mesh)
     else:

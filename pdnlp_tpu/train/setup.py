@@ -57,14 +57,18 @@ def setup_data(args, *, num_shards: int = 1, shard_id: int = 0,
     return train_loader, dev_loader, tok
 
 
-def setup_model(args, vocab_size: int):
-    """(cfg, tx, state) — seeded the reference's way (one seed, 123)."""
+def setup_model(args, vocab_size: int, total_steps: int = None):
+    """(cfg, tx, state) — seeded the reference's way (one seed, 123).
+    ``total_steps`` sizes the optional ``--lr_schedule``."""
+    from pdnlp_tpu.train.optim import make_schedule
     from pdnlp_tpu.train.steps import init_state
-
     from pdnlp_tpu.utils.seeding import train_key
 
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
                      dropout=args.dropout, attn_dropout=args.attn_dropout)
+    if getattr(args, "lr_schedule", None) and total_steps is None:
+        raise ValueError("--lr_schedule needs total_steps (pass the loader "
+                         "length x epochs to setup_model)")
     root = set_seed(args.seed)
     init_key, _ = jax.random.split(root)
     train_rng = train_key(args.seed, getattr(args, "rng_impl", "rbg"))
@@ -73,6 +77,8 @@ def setup_model(args, vocab_size: int):
         from pdnlp_tpu.train.pretrain import load_encoder
 
         params = load_encoder(args.init_from, params)
-    tx = build_optimizer(params, args)
+    tx = build_optimizer(params, args,
+                         schedule=make_schedule(args, total_steps)
+                         if total_steps else None)
     state = init_state(init_key, cfg, tx, rng=train_rng, params=params)
     return cfg, tx, state

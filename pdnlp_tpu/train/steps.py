@@ -32,6 +32,13 @@ State = Dict[str, Any]  # {'params', 'opt_state', 'step', 'rng'}
 Metrics = Dict[str, jax.Array]
 
 
+def _unroll(args):
+    """Layer-scan unroll from ``Args``: None = full unroll (fastest
+    measured), an int = that factor (1 = rolled scan, flat compile)."""
+    u = getattr(args, "scan_unroll", None)
+    return True if u is None else u
+
+
 def init_state(key: jax.Array, cfg: BertConfig, tx: optax.GradientTransformation,
                rng: jax.Array = None, params=None) -> State:
     """Canonical train-state schema.  ``params`` may be passed pre-built
@@ -64,11 +71,12 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
+    unroll = _unroll(args)
 
     def loss_fn(params, batch, rng):
         logits = bert.classify(
             params, cfg, batch, dtype=dtype, deterministic=False, rng=rng,
-            remat=remat, attn_impl=attn_impl,
+            remat=remat, attn_impl=attn_impl, unroll=unroll,
         )
         loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"])
         return loss, correct
@@ -136,10 +144,12 @@ def build_eval_step(cfg: BertConfig, args) -> Callable[..., Metrics]:
     """
     dtype = resolve_dtype(args.dtype)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
+    unroll = _unroll(args)
 
     def eval_step(params, batch) -> Metrics:
         logits = bert.classify(params, cfg, batch, dtype=dtype,
-                               deterministic=True, attn_impl=attn_impl)
+                               deterministic=True, attn_impl=attn_impl,
+                               unroll=unroll)
         w = batch["example_weight"]
         loss, correct = weighted_ce(logits, batch["label"], w)
         return {

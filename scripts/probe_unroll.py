@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Does unrolling the 12-layer lax.scan buy step time on the chip?"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "output/xla_cache")
+
+import pdnlp_tpu.models.bert as bert_mod
+from pdnlp_tpu.models import bert, get_config
+from pdnlp_tpu.train.optim import build_optimizer
+from pdnlp_tpu.train.steps import build_train_step, init_state
+from pdnlp_tpu.utils.config import Args
+
+N = 50
+B, S = 32, 128
+
+args = Args(strategy="dp", dtype="bfloat16")
+cfg = get_config(args.model, vocab_size=6013, num_labels=6)
+key = jax.random.PRNGKey(0)
+params = bert.init_params(key, cfg)
+tx = build_optimizer(params, args)
+state = init_state(key, cfg, tx, rng=jax.random.key(0, impl="rbg"),
+                   params=params)
+batch = jax.device_put({
+    "input_ids": jnp.ones((B, S), jnp.int32),
+    "token_type_ids": jnp.zeros((B, S), jnp.int32),
+    "attention_mask": jnp.ones((B, S), jnp.int32),
+    "label": jnp.zeros((B,), jnp.int32),
+    "example_weight": jnp.ones((B,), jnp.float32),
+})
+
+orig_scan = jax.lax.scan
+
+
+def timeit(name, fn):
+    out = fn()
+    jax.block_until_ready(out)
+    float(jnp.sum(out).astype(jnp.float32))
+    t0 = time.time()
+    for _ in range(N):
+        out = fn()
+    float(jnp.sum(out).astype(jnp.float32))
+    print(f"{name:24s}: {(time.time()-t0)/N*1e3:7.2f} ms")
+
+
+for unroll in (1, 2, 4, 12):
+    def scan_u(f, init, xs, **kw):
+        kw.pop("unroll", None)
+        return orig_scan(f, init, xs, unroll=unroll, **kw)
+
+    bert_mod.jax.lax.scan = scan_u if unroll > 1 else orig_scan
+    try:
+        step = jax.jit(build_train_step(cfg, tx, args))
+        timeit(f"unroll={unroll}", lambda: step(state, batch)[1]["loss"])
+    finally:
+        bert_mod.jax.lax.scan = orig_scan

@@ -40,12 +40,17 @@ RUNS = [
      None),
     # the spawn launcher forks real processes; on the one-chip image it runs
     # on the CPU backend with 2 processes x 4 virtual devices (the same
-    # configuration the spawn execution test pins)
+    # configuration the spawn execution test pins).  bert-small from
+    # scratch: a bert-base run crosses jax.distributed's shutdown-barrier
+    # deadline while rank 0 gloo-allgathers the 365MB checkpoint, and the
+    # bert-base pretrain ckpt cannot warm-start a small model anyway —
+    # this row is execution evidence (loss parity is pinned by
+    # tests/test_spawn.py), not an accuracy comparison.
     ("spawn 2-proc (CPU backend)",
      [sys.executable, "multi-tpu-spawn-cls.py", "--num_processes", "2",
-      "--init_from", CKPT, "--data_limit", "2000", "--ckpt_name",
+      "--model", "bert-small", "--data_limit", "2000", "--ckpt_name",
       "spawn-cls.msgpack"],
-     {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+     {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
      "output/spawn-cls.msgpack"),
 ]
 

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Round 2: schedule x epochs combinations on the best pretrain ckpt."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import optax
+
+jax.config.update("jax_compilation_cache_dir", "output/xla_cache")
+
+from pdnlp_tpu.train.run import build_parallel_trainer
+from pdnlp_tpu.utils.config import Args
+
+CKPT = "output/pretrained_p30.msgpack"
+
+
+def run(tag, schedule_fn=None, **kw):
+    import pdnlp_tpu.parallel.execution as ex
+    import pdnlp_tpu.train.optim as optim_mod
+
+    orig = optim_mod.build_optimizer
+    if schedule_fn is not None:
+        def patched(params, args, schedule=None):
+            return orig(params, args, schedule=schedule_fn)
+        optim_mod.build_optimizer = patched
+        ex_orig = ex.build_optimizer
+        ex.build_optimizer = patched
+    try:
+        args = Args(strategy="exp", dtype="bfloat16", init_from=CKPT,
+                    dev=True, eval_step=50, log_every=10 ** 9,
+                    ckpt_name="sweep-tmp.msgpack", **kw)
+        tr, loader, dev_loader = build_parallel_trainer(args, mode="dp")
+        tr.train(loader, dev_loader)
+        print(f"{tag:30s} best={tr.best_accuracy:.4f}", flush=True)
+    finally:
+        if schedule_fn is not None:
+            optim_mod.build_optimizer = orig
+            ex.build_optimizer = ex_orig
+
+
+def wl(peak, total, frac=0.06):
+    w = max(1, int(total * frac))
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, peak, w),
+         optax.linear_schedule(peak, 0.0, total - w)], [w])
+
+
+run("2ep warmup+linear 5e-5", schedule_fn=wl(5e-5, 576), epochs=2)
+run("2ep warmup+linear 3e-5", schedule_fn=wl(3e-5, 576), epochs=2)
+run("3ep warmup+linear 5e-5", schedule_fn=wl(5e-5, 864), epochs=3)
+run("3ep const 3e-5", epochs=3)
+run("2ep const 5e-5", learning_rate=5e-5, epochs=2)

@@ -22,7 +22,8 @@ from pdnlp_tpu.utils.metrics import classification_report
 
 def main(args: Args) -> float:
     train_loader, dev_loader, tok = setup_data(args)
-    cfg, tx, state = setup_model(args, tok.vocab_size)
+    cfg, tx, state = setup_model(args, tok.vocab_size,
+                                 total_steps=len(train_loader) * args.epochs)
     rank0_print(f"device: {jax.devices()[0].platform}  model: {args.model}  "
                 f"dtype: {args.dtype}  steps/epoch: {len(train_loader)}")
     trainer = Trainer(
