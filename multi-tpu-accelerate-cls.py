@@ -29,11 +29,15 @@ def main(args: Args) -> float:
 
     # user-style single-device setup (the reference's main() body).
     # total_steps for the LR schedule must reflect the POST-prepare() loader:
-    # prepare scales batches by accelerator.batch_mult, shrinking the step
-    # count (the same division the reference highlights at :145,271).
+    # prepare scales batches by accelerator.batch_mult AND reshards the
+    # sampler across processes, shrinking the step count by both factors
+    # (the same division the reference highlights at :145,271).
+    import jax
+
     train_loader, dev_loader, tok = setup_data(args)
-    global_batch = args.train_batch_size * accelerator.batch_mult
-    steps_per_epoch = -(-len(train_loader.sampler) // global_batch)
+    per_process_batch = args.train_batch_size * accelerator.batch_mult
+    per_process_n = -(-len(train_loader.sampler) // jax.process_count())
+    steps_per_epoch = -(-per_process_n // per_process_batch)
     cfg, tx, state = setup_model(args, tok.vocab_size,
                                  total_steps=steps_per_epoch * args.epochs)
 

@@ -23,7 +23,11 @@ def parse_trainer_args(argv=None) -> TrainerArgs:
     p = argparse.ArgumentParser()
     add_dataclass_args(p, TrainerArgs)
     ns, _ = p.parse_known_args(argv)
-    return TrainerArgs(**vars(ns))
+    targs = TrainerArgs(**vars(ns))
+    from pdnlp_tpu.utils.config import enable_compilation_cache
+
+    enable_compilation_cache(targs.to_args())
+    return targs
 
 
 if __name__ == "__main__":

@@ -48,10 +48,6 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
 
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
                      dropout=args.dropout, attn_dropout=args.attn_dropout)
-    if getattr(args, "lr_schedule", None) and total_steps is None:
-        raise ValueError("--lr_schedule needs total_steps (pass the loader "
-                         "length x epochs to setup_sharded_model)")
-
     root = set_seed(args.seed)
     init_key, _ = jax.random.split(root)
     train_rng = train_key(args.seed, getattr(args, "rng_impl", "rbg"))
@@ -59,8 +55,7 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
     # tx needs a params *structure* for the weight-decay mask — shapes only.
     param_shapes = jax.eval_shape(lambda k: bert.init_params(k, cfg), init_key)
     tx = build_optimizer(param_shapes, args,
-                         schedule=make_schedule(args, total_steps)
-                         if total_steps else None)
+                         schedule=make_schedule(args, total_steps))
 
     def init_fn(key, rng):
         params = bert.init_params(key, cfg)
@@ -68,7 +63,22 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
 
     state_shapes = jax.eval_shape(init_fn, init_key, train_rng)
     shardings = state_shardings(state_shapes, mesh, mode)
+    offload = getattr(args, "offload_opt_state", False)
     state = jax.jit(init_fn, out_shardings=shardings)(init_key, train_rng)
+    if offload:
+        # Adam moments move to host RAM (DeepSpeed offload_optimizer
+        # analog); the train step stages them explicitly.  The move happens
+        # EAGERLY after init — memory-kind annotations inside the init jit
+        # would spread to its integer outputs, which XLA's SPMD partitioner
+        # rejects ("Side-effect HLO must have sharding" on s32 scalars).
+        from pdnlp_tpu.parallel.sharding import with_memory_kind
+
+        shardings = dict(shardings)
+        shardings["opt_state"] = with_memory_kind(
+            shardings["opt_state"], "pinned_host",
+            shape_tree=state_shapes["opt_state"])
+        state["opt_state"] = jax.device_put(state["opt_state"],
+                                            shardings["opt_state"])
     if getattr(args, "init_from", None):
         # warm-start the encoder from an in-repo pretrain checkpoint (the
         # from_pretrained analog); head stays fresh, placement is preserved
@@ -83,7 +93,21 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
 def make_parallel_train_step(cfg: BertConfig, tx, args, mesh: Mesh, shardings):
     """Compile the fused train step over the mesh.  DP vs ZeRO is entirely
     encoded in ``shardings`` — the step function is identical."""
-    fn = build_train_step(cfg, tx, args)
+    opt_staging = None
+    if getattr(args, "offload_opt_state", False):
+        from jax.sharding import NamedSharding
+
+        # host-kind leaves (the float moments) stage to device and back;
+        # everything else keeps its original sharding — explicit memory-kind
+        # annotations on replicated integer scalars break SPMD partitioning
+        def to_device(s):
+            if getattr(s, "memory_kind", None) == "pinned_host":
+                return NamedSharding(s.mesh, s.spec, memory_kind="device")
+            return s
+
+        opt_staging = (jax.tree_util.tree_map(to_device, shardings["opt_state"]),
+                       shardings["opt_state"])
+    fn = build_train_step(cfg, tx, args, opt_staging=opt_staging)
     return jax.jit(
         fn,
         donate_argnums=0,

@@ -77,6 +77,35 @@ def state_shardings(state_shapes: Any, mesh: Mesh, mode: str = "dp",
     return jax.tree_util.tree_map(rule, state_shapes)
 
 
+def with_memory_kind(sharding_tree: Any, kind: str,
+                     shape_tree: Any = None) -> Any:
+    """Same placement, different memory space — ``"pinned_host"`` moves a
+    subtree (e.g. optimizer moments) to host RAM, the DeepSpeed
+    ``offload_optimizer`` analog.  XLA stages host<->device copies around
+    any compute that touches the leaves (see ``train.steps``).
+
+    When ``shape_tree`` (eval_shape structs) is given, only FLOATING leaves
+    move: the bytes are all in the fp32 moments anyway, and XLA's SPMD
+    partitioner rejects host-placement annotations on replicated integer
+    scalars (optax's step count) over a multi-device mesh."""
+    if shape_tree is None:
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(s.mesh, s.spec, memory_kind=kind),
+            sharding_tree)
+    import jax.numpy as jnp
+
+    def rule(s, shp):
+        try:
+            is_float = jnp.issubdtype(shp.dtype, jnp.floating)
+        except TypeError:
+            is_float = False
+        if not is_float:
+            return s
+        return NamedSharding(s.mesh, s.spec, memory_kind=kind)
+
+    return jax.tree_util.tree_map(rule, sharding_tree, shape_tree)
+
+
 def shard_fraction(state, mesh) -> float:
     """Measured per-device fraction of total state bytes (tests/diagnostics:
     ~1/axis_size under ``zero``, 1.0 under ``dp``)."""

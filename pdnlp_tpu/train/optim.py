@@ -41,13 +41,23 @@ def decay_mask(params) -> object:
     return debias(params, masked)
 
 
-def make_schedule(args, total_steps: int):
+def make_schedule(args, total_steps):
     """Learning-rate schedule from ``Args`` (``--lr_schedule``), or ``None``
     for the reference's constant LR.  ``warmup_linear`` (the BERT-paper
     recipe) measured best on the fine-tune sweep: +0.8 dev accuracy over
-    constant 3e-5 at peak 5e-5 (``scripts/sweep_recipe.py``)."""
+    constant 3e-5 at peak 5e-5 (``scripts/sweep_recipe.py``).
+
+    Raises when a schedule is configured but ``total_steps`` is missing or
+    zero — a silently constant LR under ``--lr_schedule`` (e.g. from an
+    empty loader) is the failure mode this guard exists for."""
     if not getattr(args, "lr_schedule", None):
         return None
+    if not total_steps:
+        raise ValueError(
+            f"--lr_schedule {args.lr_schedule!r} needs a positive "
+            f"total_steps to size warmup/decay; got {total_steps!r} "
+            "(empty train loader, or a caller not passing loader length x "
+            "epochs)")
     w = max(1, int(total_steps * args.warmup_ratio))
     if args.lr_schedule == "warmup_linear":
         return optax.join_schedules(

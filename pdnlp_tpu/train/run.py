@@ -47,6 +47,12 @@ def build_parallel_trainer(
     if mesh is None:
         proc0 = init_runtime(args)[0] == 0  # noqa: F841  (rendezvous side effect)
         mesh = make_mesh(num_devices=args.num_devices, shape=args.mesh_shape)
+    if getattr(args, "offload_opt_state", False) and (
+            explicit_collectives or args.fuse_steps > 1):
+        raise ValueError("--offload_opt_state works with the jit strategies "
+                         "(dp/zero), not shard_map or fused multi-steps — "
+                         "the staged host<->device transfers are only wired "
+                         "into the plain train step")
     mult = local_batch_mult(mesh) if scale_batch else 1
     train_loader, dev_loader, tok = setup_data(
         args,

@@ -66,9 +66,6 @@ def setup_model(args, vocab_size: int, total_steps: int = None):
 
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
                      dropout=args.dropout, attn_dropout=args.attn_dropout)
-    if getattr(args, "lr_schedule", None) and total_steps is None:
-        raise ValueError("--lr_schedule needs total_steps (pass the loader "
-                         "length x epochs to setup_model)")
     root = set_seed(args.seed)
     init_key, _ = jax.random.split(root)
     train_rng = train_key(args.seed, getattr(args, "rng_impl", "rbg"))
@@ -78,7 +75,6 @@ def setup_model(args, vocab_size: int, total_steps: int = None):
 
         params = load_encoder(args.init_from, params)
     tx = build_optimizer(params, args,
-                         schedule=make_schedule(args, total_steps)
-                         if total_steps else None)
+                         schedule=make_schedule(args, total_steps))
     state = init_state(init_key, cfg, tx, rng=train_rng, params=params)
     return cfg, tx, state

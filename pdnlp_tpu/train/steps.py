@@ -64,10 +64,19 @@ def weighted_ce(logits: jax.Array, labels: jax.Array, weights: jax.Array
     return loss, correct
 
 
-def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args
+def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
+                     opt_staging=None,
                      ) -> Callable[[State, Dict[str, jax.Array]], Tuple[State, Metrics]]:
     """The *unjitted* fused train step — callers choose how to compile it
-    (plain ``jit``, ``jit`` with mesh shardings, or inside ``shard_map``)."""
+    (plain ``jit``, ``jit`` with mesh shardings, or inside ``shard_map``).
+
+    ``opt_staging``: ``(device_shardings, host_shardings)`` trees for the
+    optimizer state when it lives in host memory (``--offload_opt_state``,
+    the DeepSpeed ``offload_optimizer`` analog): the step explicitly stages
+    moments host->device before the update and back after — XLA refuses
+    mixed-memory-space arithmetic, so the transfers are part of the program.
+    Measured ~4x step cost on v5e for BERT-base; the win is the ~800MB of
+    HBM the fp32 moments no longer occupy."""
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
@@ -86,7 +95,12 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args
         (loss, correct), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state["params"], batch, rng
         )
-        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        opt_in = state["opt_state"]
+        if opt_staging is not None:
+            opt_in = jax.device_put(opt_in, opt_staging[0])   # host -> device
+        updates, opt_state = tx.update(grads, opt_in, state["params"])
+        if opt_staging is not None:
+            opt_state = jax.device_put(opt_state, opt_staging[1])  # -> host
         params = optax.apply_updates(state["params"], updates)
         new_state = {
             "params": params,

@@ -11,7 +11,6 @@ import jax.numpy as jnp
 
 jax.config.update("jax_compilation_cache_dir", "output/xla_cache")
 
-import pdnlp_tpu.models.bert as bert_mod
 from pdnlp_tpu.models import bert, get_config
 from pdnlp_tpu.train.optim import build_optimizer
 from pdnlp_tpu.train.steps import build_train_step, init_state
@@ -35,9 +34,6 @@ batch = jax.device_put({
     "example_weight": jnp.ones((B,), jnp.float32),
 })
 
-orig_scan = jax.lax.scan
-
-
 def timeit(name, fn):
     out = fn()
     jax.block_until_ready(out)
@@ -49,14 +45,7 @@ def timeit(name, fn):
     print(f"{name:24s}: {(time.time()-t0)/N*1e3:7.2f} ms")
 
 
+# scan_unroll=1 is the rolled scan, 12 == full unroll (also the None default)
 for unroll in (1, 2, 4, 12):
-    def scan_u(f, init, xs, **kw):
-        kw.pop("unroll", None)
-        return orig_scan(f, init, xs, unroll=unroll, **kw)
-
-    bert_mod.jax.lax.scan = scan_u if unroll > 1 else orig_scan
-    try:
-        step = jax.jit(build_train_step(cfg, tx, args))
-        timeit(f"unroll={unroll}", lambda: step(state, batch)[1]["loss"])
-    finally:
-        bert_mod.jax.lax.scan = orig_scan
+    step = jax.jit(build_train_step(cfg, tx, args.replace(scan_unroll=unroll)))
+    timeit(f"unroll={unroll}", lambda: step(state, batch)[1]["loss"])

@@ -6,7 +6,6 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import optax
 
 jax.config.update("jax_compilation_cache_dir", "output/xla_cache")
 
@@ -40,11 +39,13 @@ def run(tag, schedule_fn=None, **kw):
             ex.build_optimizer = ex_orig
 
 
-def wl(peak, total, frac=0.06):
-    w = max(1, int(total * frac))
-    return optax.join_schedules(
-        [optax.linear_schedule(0.0, peak, w),
-         optax.linear_schedule(peak, 0.0, total - w)], [w])
+def wl(peak, total):
+    """The shipped warmup_linear schedule, built by the same helper the
+    framework uses (one formula, one place: optim.make_schedule)."""
+    from pdnlp_tpu.train.optim import make_schedule
+
+    return make_schedule(Args(lr_schedule="warmup_linear",
+                              learning_rate=peak), total)
 
 
 run("2ep warmup+linear 5e-5", schedule_fn=wl(5e-5, 576), epochs=2)

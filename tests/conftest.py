@@ -16,6 +16,10 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# persistent XLA compile cache: compilation dominates suite wall-clock, and
+# most test programs are identical run to run
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), ".xla_cache"))
 
 import pytest  # noqa: E402
 

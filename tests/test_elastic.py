@@ -50,6 +50,44 @@ def elastic_run(tmp_path_factory):
     return proc, out
 
 
+def test_gang_monitor_stall_detection(tmp_path):
+    """The stall detector (no crash, heartbeats stop) — unit-level, no
+    processes: verdicts depend only on child poll() codes and heartbeat
+    file mtimes."""
+    import time
+
+    from pdnlp_tpu.parallel.watchdog import GangMonitor, Heartbeat
+
+    class FakeProc:
+        def __init__(self, code=None):
+            self.code = code
+
+        def poll(self):
+            return self.code
+
+    procs = [FakeProc(), FakeProc()]
+    mon = GangMonitor(procs, str(tmp_path), 2, stall_timeout=0.3)
+    # no rank has ever beaten: grace period, healthy
+    assert mon.poll() is None
+    # both beat now -> healthy
+    hb0 = Heartbeat(str(tmp_path), 0, interval=0.0)
+    hb1 = Heartbeat(str(tmp_path), 1, interval=0.0)
+    hb0.beat(force=True)
+    hb1.beat(force=True)
+    assert mon.poll() is None
+    # rank 1 goes quiet past the timeout while rank 0 keeps beating
+    time.sleep(0.4)
+    hb0.beat(force=True)
+    v = mon.poll()
+    assert v is not None and v["kind"] == "stalled", v
+    # a nonzero child exit is classified as a crash (takes precedence)
+    procs[1].code = 13
+    assert mon.poll()["kind"] == "crashed"
+    # all children exiting 0 ends the run
+    procs[0].code = procs[1].code = 0
+    assert mon.poll()["kind"] == "done"
+
+
 def test_elastic_restart_completes(elastic_run):
     proc, out = elastic_run
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-3000:])

@@ -141,6 +141,57 @@ def test_zero_shards_state_memory(ndev):
     assert shard_fraction(zero_state, mesh) < 1.5 / ndev
 
 
+def test_offload_opt_state_matches_dp(ndev):
+    """--offload_opt_state (DeepSpeed offload_optimizer analog): Adam
+    moments live in pinned host memory, the step stages them explicitly,
+    and three updates produce the same params as the on-device run.
+
+    TPU-only: XLA:CPU has no implementation of the memory-space
+    annotation custom-call ("No registered implementation ... for Host"),
+    so this executes on the real chip (where scripts/probe_offload.py
+    measured it at ~4x step cost) and skips in the CPU CI mesh — the
+    placement/flag plumbing still runs here up to the compile."""
+    if jax.default_backend() != "tpu":
+        off_args = tiny_args(offload_opt_state=True)
+        mesh = make_mesh(num_devices=1)
+        _, _, state, _ = setup_sharded_model(off_args, VOCAB, mesh, "dp")
+        kinds = {l.sharding.memory_kind
+                 for l in jax.tree_util.tree_leaves(state["opt_state"])
+                 if isinstance(l, jax.Array)
+                 and jnp.issubdtype(l.dtype, jnp.floating)}
+        assert kinds == {"pinned_host"}, kinds
+        pytest.skip("XLA:CPU lacks annotate_device_placement; the staged "
+                    "step itself is TPU-only (probe-measured)")
+    args = tiny_args()
+    batches = [fake_batch(8, seed=i) for i in range(3)]
+    mesh = make_mesh(num_devices=1)
+    put = make_global_batch(mesh)
+
+    cfg, tx, ref_state, ref_sh = setup_sharded_model(args, VOCAB, mesh, "dp")
+    ref_step = make_parallel_train_step(cfg, tx, args, mesh, ref_sh)
+    for b in batches:
+        ref_state, ref_m = ref_step(ref_state, put(b))
+
+    off_args = tiny_args(offload_opt_state=True)
+    def float_kinds(opt_state):
+        return {l.sharding.memory_kind
+                for l in jax.tree_util.tree_leaves(opt_state)
+                if isinstance(l, jax.Array)
+                and jnp.issubdtype(l.dtype, jnp.floating)}
+
+    cfg2, tx2, state, sh = setup_sharded_model(off_args, VOCAB, mesh, "dp")
+    # the moments (all the bytes) really are host-resident
+    assert float_kinds(state["opt_state"]) == {"pinned_host"}
+    step = make_parallel_train_step(cfg2, tx2, off_args, mesh, sh)
+    for b in batches:
+        state, m = step(state, put(b))
+    assert float_kinds(state["opt_state"]) == {"pinned_host"}
+    assert float(m["loss"]) == pytest.approx(float(ref_m["loss"]), rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_state["params"]),
+                    jax.tree_util.tree_leaves(state["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
 def test_shardmap_matches_dp(ndev):
     """Explicit-collective (Horovod-analog) step == XLA-inserted collectives,
     with dropout off and bf16 wire compression disabled."""
