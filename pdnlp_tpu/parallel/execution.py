@@ -85,7 +85,8 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
         # (ZeRO leaves go straight to their shards)
         from pdnlp_tpu.train.pretrain import load_encoder
 
-        params = load_encoder(args.init_from, state["params"])
+        params = load_encoder(args.init_from, state["params"],
+                              head=getattr(args, "init_head", False))
         state["params"] = jax.device_put(params, shardings["params"])
     return cfg, tx, state, shardings
 

@@ -175,6 +175,58 @@ def build_pretrain_corpus(args, tok: WordPieceTokenizer) -> Dict[str, np.ndarray
     return packed
 
 
+def build_supervised_corpus(args):
+    """Labeled examples OUTSIDE the fine-tune slice.
+
+    The reference's protocol only ever touches ``data[:10000]``
+    (``/root/reference/single-gpu-cls.py:226``); the remaining 30,133
+    ``(text, label)`` pairs are unused in-repo supervision.  Texts that also
+    appear verbatim in the fine-tune DEV split are dropped (49 duplicates in
+    the shipped corpus) so the stage never sees a dev label."""
+    data = load_data(args.data_path)
+    _, dev = split_data(data, seed=args.seed, limit=args.data_limit,
+                        ratio=args.ratio)
+    held_out = {t for t, _ in dev}
+    ext = [(t, l) for t, l in data[args.data_limit:] if t not in held_out]
+    if args.pretrain_limit:
+        ext = ext[: args.pretrain_limit]
+    return ext
+
+
+def run_supervised_stage(args) -> str:
+    """Phase 2 of in-repo pretraining: supervised classification over the
+    held-out labeled externals (``build_supervised_corpus``), warm-started
+    from the MLM checkpoint (``args.init_from``).
+
+    This is the in-repo twin of intermediate-task transfer: where the
+    reference's accuracy comes from 5.4B externally-pretrained tokens, this
+    stage mines the label signal the benchmark protocol leaves on the floor.
+    The dev split is untouched (and its duplicate texts excluded), so the
+    resulting dev accuracy is an honest held-out number.
+
+    Writes FULL params (encoder + pooler + classifier) to
+    ``args.ckpt_path()``; fine-tune entrypoints restore the trunk by default
+    and the trained head too under ``--init_head true``.  Returns the path.
+    """
+    from pdnlp_tpu.train.run import build_parallel_trainer
+
+    if args.dev:
+        raise ValueError(
+            "run_supervised_stage trains with dev=False: selecting a "
+            "pretrain artifact on the fine-tune dev split would leak the "
+            "benchmark's model-selection signal into pretraining (and "
+            "Trainer.train would only write the checkpoint on an eval "
+            "improvement). Evaluate after fine-tuning instead.")
+    ext = build_supervised_corpus(args)
+    trainer, loader, _ = build_parallel_trainer(
+        args, mode="dp", train_override=ext)
+    rank0_print(f"supervised stage: {len(ext)} labeled externals, "
+                f"{args.epochs} epochs x {len(loader)} steps, "
+                f"lr {args.learning_rate}")
+    trainer.train(loader, None)
+    return args.ckpt_path()
+
+
 def run_pretrain(args) -> str:
     """Pretrain and write the encoder checkpoint; returns its path.
 
@@ -245,30 +297,46 @@ def run_pretrain(args) -> str:
             # epoch-curve checkpoints: lets an accuracy-vs-pretrain-compute
             # sweep fine-tune from several depths of ONE run
             ckpt.save_params(
-                args.ckpt_path(f"pretrained-e{epoch}.msgpack"), state)
+                args.ckpt_path(f"pretrained-e{epoch}.msgpack"),
+                {"params": _mlm_artifact(state["params"])})
     if last is not None:
         float(jax.device_get(last["loss"]))  # completion barrier
     minutes = (time.time() - start) / 60
     rank0_print(f"pretrain 耗时：{minutes:.4f}分钟")
     path = args.ckpt_path(args.ckpt_name or "pretrained.msgpack")
-    ckpt.save_params(path, state)
+    ckpt.save_params(path, {"params": _mlm_artifact(state["params"])})
     rank0_print(f"pretrained encoder -> {path}")
     return path
 
 
-def load_encoder(path: str, params):
+def _mlm_artifact(params):
+    """What the MLM stage actually trained: encoder + tied head.  The fresh
+    pooler/classifier are dropped so ``load_encoder(head=True)`` on an MLM
+    artifact fails loudly instead of silently restoring untrained noise."""
+    return {k: v for k, v in params.items() if k not in ("pooler", "classifier")}
+
+
+def load_encoder(path: str, params, head: bool = False):
     """Initialize fine-tune params from a pretrain checkpoint: embeddings +
     layers come from the file, pooler/classifier stay at fresh init — the
-    ``from_pretrained`` analog (new head on a pretrained trunk)."""
+    ``from_pretrained`` analog (new head on a pretrained trunk).
+
+    ``head=True`` additionally restores pooler + classifier — for checkpoints
+    written by the supervised stage (``run_supervised_stage``), whose head was
+    trained on the same 6-class task and is worth keeping."""
     import flax.serialization as ser
 
     with open(path, "rb") as f:
         restored = ser.msgpack_restore(f.read())
+    keys = ("embeddings", "layers") + (("pooler", "classifier") if head else ())
     out = dict(params)
-    for key in ("embeddings", "layers"):
+    for key in keys:
         if key not in restored:
-            raise ValueError(f"{path!r} has no {key!r} tree — not a "
-                             "pretrain checkpoint?")
+            raise ValueError(
+                f"{path!r} has no {key!r} tree — "
+                + ("not a supervised-pretrain checkpoint? (--init_head needs "
+                   "one; MLM checkpoints carry no classifier)" if head else
+                   "not a pretrain checkpoint?"))
         tmpl = params[key]
         got = jax.tree_util.tree_map(jnp.asarray, restored[key])
         t_shapes = jax.tree_util.tree_map(lambda l: l.shape, tmpl)

@@ -42,8 +42,12 @@ def build_parallel_trainer(
     explicit_collectives: bool = False,
     scale_batch: bool = True,
     mesh=None,
+    train_override=None,
 ) -> Tuple[Trainer, object, object]:
-    """(trainer, train_loader, dev_loader) wired for the given strategy."""
+    """(trainer, train_loader, dev_loader) wired for the given strategy.
+
+    ``train_override`` swaps the train split's examples (supervised-pretrain
+    stage); everything else — dev split, mesh, sharding, step — is shared."""
     if mesh is None:
         proc0 = init_runtime(args)[0] == 0  # noqa: F841  (rendezvous side effect)
         mesh = make_mesh(num_devices=args.num_devices, shape=args.mesh_shape)
@@ -59,6 +63,7 @@ def build_parallel_trainer(
         num_shards=jax.process_count(),
         shard_id=jax.process_index(),
         device_batch_mult=mult,
+        train_override=train_override,
     )
     cfg, tx, state, shardings = setup_sharded_model(
         args, tok.vocab_size, mesh, mode,

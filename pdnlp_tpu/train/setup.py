@@ -20,17 +20,22 @@ from pdnlp_tpu.utils.seeding import set_seed
 
 
 def setup_data(args, *, num_shards: int = 1, shard_id: int = 0,
-               device_batch_mult: int = 1) -> Tuple[DataLoader, DataLoader, WordPieceTokenizer]:
+               device_batch_mult: int = 1,
+               train_override=None) -> Tuple[DataLoader, DataLoader, WordPieceTokenizer]:
     """(train_loader, dev_loader, tokenizer).
 
     ``device_batch_mult`` scales the per-host batch for single-controller
     data parallelism (global batch = per-device 32 × #devices, so step count
     matches the reference's ``DistributedSampler`` math: 288 single / 144 at
     2-way).  ``num_shards``/``shard_id`` split the *dataset* across host
-    processes for the multi-process launcher variants.
+    processes for the multi-process launcher variants.  ``train_override``
+    replaces the train split's examples (the supervised-pretrain stage trains
+    on the labeled externals while keeping the standard dev split).
     """
     data = load_data(args.data_path)
     train, dev = split_data(data, seed=args.seed, limit=args.data_limit, ratio=args.ratio)
+    if train_override is not None:
+        train = list(train_override)
     tok = WordPieceTokenizer(get_or_build_vocab(args))
     from pdnlp_tpu.data import native
 
@@ -64,6 +69,11 @@ def setup_model(args, vocab_size: int, total_steps: int = None):
     from pdnlp_tpu.train.steps import init_state
     from pdnlp_tpu.utils.seeding import train_key
 
+    if getattr(args, "offload_opt_state", False):
+        raise ValueError("--offload_opt_state is wired into the mesh "
+                         "strategies (dp/zero via build_parallel_trainer), "
+                         "not this entrypoint — it would be silently ignored "
+                         "here")
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
                      dropout=args.dropout, attn_dropout=args.attn_dropout)
     root = set_seed(args.seed)
@@ -73,7 +83,8 @@ def setup_model(args, vocab_size: int, total_steps: int = None):
     if getattr(args, "init_from", None):
         from pdnlp_tpu.train.pretrain import load_encoder
 
-        params = load_encoder(args.init_from, params)
+        params = load_encoder(args.init_from, params,
+                              head=getattr(args, "init_head", False))
     tx = build_optimizer(params, args,
                          schedule=make_schedule(args, total_steps))
     state = init_state(init_key, cfg, tx, rng=train_rng, params=params)

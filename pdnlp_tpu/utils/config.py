@@ -40,6 +40,14 @@ class Args:
     mlm_span: bool = True                         # n-gram (wwm-analog) masking
     pretrain_limit: Optional[int] = None          # cap pretrain texts (tests)
     pretrain_ckpt_every: Optional[int] = None     # epoch-curve checkpoints
+    sft_epochs: int = 0                           # supervised pretrain stage:
+                                                  # epochs over the ~30k labeled
+                                                  # examples outside the
+                                                  # fine-tune slice (0 = off)
+    sft_lr: float = 3e-5                          # its peak learning rate
+    init_head: bool = False                       # --init_from also restores
+                                                  # pooler+classifier (for
+                                                  # supervised-pretrain ckpts)
 
     # --- optimization (single-gpu-cls.py:86-97,193-205) ---
     learning_rate: float = 3e-5

@@ -1,18 +1,27 @@
 #!/usr/bin/env python
-"""MLM pretraining over the full corpus — the "download pretrained weights"
-capability, rebuilt in-repo.
+"""In-repo pretraining — the "download pretrained weights" capability rebuilt.
 
 The reference's accuracy comes from ``hfl/chinese-bert-wwm-ext``
 (``/root/reference/single-gpu-cls.py:252-255``); with no egress, this stage
-produces the equivalent warm-start: masked-LM over all 40,133 corpus texts
-(minus the fine-tune dev split), packed ~7 texts per 128-token row behind a
-block-diagonal segment mask, 80/10/10 dynamic masking on device.
+produces the equivalent warm-start in two phases over in-repo data only:
+
+1. **MLM** over all 40,133 corpus texts (minus the fine-tune dev split),
+   packed ~7 texts per 128-token row behind a block-diagonal segment mask,
+   80/10/10 dynamic masking on device.
+2. **Supervised stage** (``--sft_epochs N``, default 3): classification over
+   the ~30k *labeled* examples outside the reference's ``[:10000]`` slice
+   (``single-gpu-cls.py:226``) — label signal the benchmark protocol never
+   uses.  Dev-split texts (including 49 verbatim duplicates) are excluded.
 
     python pretrain-tpu.py                         # -> output/pretrained.msgpack
     python multi-tpu-jax-cls.py --dtype bfloat16 \
-        --init_from output/pretrained.msgpack      # fine-tune from it
+        --init_from output/pretrained.msgpack \
+        --init_head true                           # fine-tune from it
+
+``--sft_epochs 0`` reproduces the MLM-only artifact; ``--init_from`` skips
+the MLM phase and runs the supervised stage from an existing checkpoint.
 """
-from pdnlp_tpu.train.pretrain import run_pretrain
+from pdnlp_tpu.train.pretrain import run_pretrain, run_supervised_stage
 from pdnlp_tpu.utils.config import Args, parse_cli
 
 
@@ -23,9 +32,41 @@ def main() -> None:
         train_batch_size=64,       # packed rows (~7 texts each)
         epochs=150,
         learning_rate=2e-4,        # fresh-init MLM wants more than 3e-5
+        sft_epochs=3,
         log_every=10 ** 9,
     ))
-    run_pretrain(args)
+    import os
+
+    final_name = args.ckpt_name or "pretrained.msgpack"
+    if args.init_from:
+        if args.sft_epochs <= 0:
+            raise SystemExit(
+                "--init_from skips the MLM phase, and --sft_epochs 0 disables "
+                "the supervised stage: nothing would run. Drop one of the two.")
+        if os.path.abspath(args.init_from) == os.path.abspath(
+                os.path.join(args.output_dir, final_name)):
+            raise SystemExit(
+                f"--init_from {args.init_from} is also where the supervised "
+                "stage would write its output — the MLM artifact would be "
+                "destroyed. Pass --ckpt_name (or move the input).")
+        mlm_path = args.init_from  # phase 2 only, from an existing checkpoint
+    elif args.sft_epochs > 0:
+        # keep the phase-1 artifact distinct so recipe sweeps can reuse it
+        if final_name == "pretrained-mlm.msgpack":
+            raise SystemExit(
+                "--ckpt_name pretrained-mlm.msgpack is the phase-1 MLM "
+                "artifact's name — the supervised stage would overwrite it. "
+                "Pick another name.")
+        mlm_path = run_pretrain(args.replace(ckpt_name="pretrained-mlm.msgpack"))
+    else:
+        run_pretrain(args.replace(ckpt_name=final_name))
+        return
+    run_supervised_stage(args.replace(
+        strategy="sft", init_from=mlm_path, init_head=False,
+        epochs=args.sft_epochs, learning_rate=args.sft_lr,
+        lr_schedule="warmup_linear", train_batch_size=32, dev=False,
+        ckpt_name=final_name,
+    ))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Supervised-stage recipe sweep: how many sft epochs, and does restoring the
+trained head at fine-tune time help?
+
+Assumes the MLM phase-1 checkpoint already exists (pretrain-tpu.py writes
+output/pretrained-mlm.msgpack when sft follows; a bare MLM artifact at
+output/pretrained.msgpack works too — pass it as argv[1]).
+
+Prints best-of-epoch dev accuracy per (sft_epochs, fine-tune recipe) cell.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pdnlp_tpu.train.pretrain import run_supervised_stage
+from pdnlp_tpu.train.run import build_parallel_trainer
+from pdnlp_tpu.utils.config import Args, enable_compilation_cache
+
+enable_compilation_cache(Args())
+
+MLM = sys.argv[1] if len(sys.argv) > 1 else "output/pretrained-mlm.msgpack"
+
+
+def finetune(tag, ckpt, **kw):
+    args = Args(strategy="exp", dtype="bfloat16", init_from=ckpt,
+                dev=True, eval_step=50, log_every=10 ** 9,
+                ckpt_name="sweep-tmp.msgpack", **kw)
+    tr, loader, dev_loader = build_parallel_trainer(args, mode="dp")
+    tr.train(loader, dev_loader)
+    print(f"{tag:44s} best={tr.best_accuracy:.4f}", flush=True)
+    return tr.best_accuracy
+
+
+for sft_epochs in (1, 2, 3, 5):
+    sft_ckpt = f"output/sft-e{sft_epochs}.msgpack"
+    if not os.path.exists(sft_ckpt):
+        run_supervised_stage(Args(
+            strategy="sft", dtype="bfloat16", init_from=MLM,
+            epochs=sft_epochs, learning_rate=3e-5,
+            lr_schedule="warmup_linear", dev=False,
+            log_every=10 ** 9, ckpt_name=os.path.basename(sft_ckpt)))
+    # reference's exact protocol: 1 epoch, constant 3e-5
+    finetune(f"sft{sft_epochs} -> ref 1ep const, fresh head", sft_ckpt)
+    finetune(f"sft{sft_epochs} -> ref 1ep const, +head", sft_ckpt,
+             init_head=True)
+    # shipped recipe: 2 epochs, linear warmup->decay
+    finetune(f"sft{sft_epochs} -> 2ep warmup_linear, +head", sft_ckpt,
+             init_head=True, epochs=2, lr_schedule="warmup_linear")

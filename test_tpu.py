@@ -33,8 +33,10 @@ def discover_checkpoints(output_dir: str):
     """Every strategy checkpoint, sorted by name (the ``models`` dict sweep,
     ``test.py:85-94``).  Recurses one managed-run layout deep so
     ``AutoTrainer``'s ``auto/checkpoint-<step>/model.msgpack`` rotation dirs
-    are swept too; ``pretrained.msgpack`` is an MLM-stage artifact (encoder +
-    head, no classifier), not a strategy checkpoint, and is excluded."""
+    are swept too; pretrain-stage artifacts (``pretrained*.msgpack`` — the
+    MLM encoder, and the supervised-stage output whose classifier saw only
+    the held-out externals, never the protocol's train split) are not
+    strategy checkpoints and are excluded."""
     return sorted(glob.glob(os.path.join(output_dir, "*-cls.msgpack"))
                   + glob.glob(os.path.join(output_dir, "model.msgpack"))
                   + glob.glob(os.path.join(output_dir, "*", "model.msgpack"))

@@ -151,15 +151,17 @@ def test_offload_opt_state_matches_dp(ndev):
     so this executes on the real chip (where scripts/probe_offload.py
     measured it at ~4x step cost) and skips in the CPU CI mesh — the
     placement/flag plumbing still runs here up to the compile."""
+    def float_kinds(opt_state):
+        return {l.sharding.memory_kind
+                for l in jax.tree_util.tree_leaves(opt_state)
+                if isinstance(l, jax.Array)
+                and jnp.issubdtype(l.dtype, jnp.floating)}
+
     if jax.default_backend() != "tpu":
         off_args = tiny_args(offload_opt_state=True)
         mesh = make_mesh(num_devices=1)
         _, _, state, _ = setup_sharded_model(off_args, VOCAB, mesh, "dp")
-        kinds = {l.sharding.memory_kind
-                 for l in jax.tree_util.tree_leaves(state["opt_state"])
-                 if isinstance(l, jax.Array)
-                 and jnp.issubdtype(l.dtype, jnp.floating)}
-        assert kinds == {"pinned_host"}, kinds
+        assert float_kinds(state["opt_state"]) == {"pinned_host"}
         pytest.skip("XLA:CPU lacks annotate_device_placement; the staged "
                     "step itself is TPU-only (probe-measured)")
     args = tiny_args()
@@ -173,12 +175,6 @@ def test_offload_opt_state_matches_dp(ndev):
         ref_state, ref_m = ref_step(ref_state, put(b))
 
     off_args = tiny_args(offload_opt_state=True)
-    def float_kinds(opt_state):
-        return {l.sharding.memory_kind
-                for l in jax.tree_util.tree_leaves(opt_state)
-                if isinstance(l, jax.Array)
-                and jnp.issubdtype(l.dtype, jnp.floating)}
-
     cfg2, tx2, state, sh = setup_sharded_model(off_args, VOCAB, mesh, "dp")
     # the moments (all the bytes) really are host-resident
     assert float_kinds(state["opt_state"]) == {"pinned_host"}

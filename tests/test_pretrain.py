@@ -12,7 +12,8 @@ import jax.numpy as jnp
 from pdnlp_tpu.data.packing import pack_texts, segment_bias
 from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
 from pdnlp_tpu.train.pretrain import (
-    PackedLoader, load_encoder, mask_tokens, run_pretrain,
+    PackedLoader, build_supervised_corpus, load_encoder, mask_tokens,
+    run_pretrain, run_supervised_stage,
 )
 from pdnlp_tpu.utils.config import Args
 
@@ -163,6 +164,75 @@ def test_pretrain_then_finetune_warmstart(tmp_path, ndev, capsys):
     np.testing.assert_allclose(
         np.asarray(zstate["params"]["layers"]["q"]["kernel"]),
         np.asarray(state["params"]["layers"]["q"]["kernel"]), rtol=0, atol=0)
+
+
+def test_supervised_corpus_is_disjoint_from_the_protocol_split():
+    """The supervised stage trains only on labeled examples OUTSIDE the
+    reference's [:10000] slice, with dev-duplicate texts dropped — no label
+    of any dev text is ever seen."""
+    from pdnlp_tpu.data.corpus import load_data, split_data
+
+    args = Args()
+    ext = build_supervised_corpus(args)
+    data = load_data(args.data_path)
+    train, dev = split_data(data, seed=args.seed, limit=args.data_limit,
+                            ratio=args.ratio)
+    dev_texts = {t for t, _ in dev}
+    assert len(ext) > 25_000                       # the slice is actually used
+    assert not any(t in dev_texts for t, _ in ext)  # zero dev leakage
+    # exactly the post-slice examples minus dev-duplicate texts, in order
+    expected = [(t, l) for t, l in data[args.data_limit:] if t not in dev_texts]
+    assert ext == expected
+
+
+def test_supervised_stage_trains_and_head_restores(tmp_path, ndev):
+    """Tiny real supervised stage: checkpoint carries pooler+classifier,
+    --init_head restores them bit-exactly, and head=True on an MLM-only
+    checkpoint fails loudly."""
+    common = dict(model="bert-tiny", max_seq_len=32, data_limit=500,
+                  output_dir=str(tmp_path), log_every=10 ** 9,
+                  dropout=0.0, attn_dropout=0.0)
+    mlm_path = run_pretrain(Args(strategy="pretrain", train_batch_size=8,
+                                 epochs=1, learning_rate=1e-3,
+                                 pretrain_limit=200,
+                                 ckpt_name="mlm.msgpack", **common))
+    sft_path = run_supervised_stage(Args(
+        strategy="sft", train_batch_size=8, epochs=1, pretrain_limit=200,
+        init_from=mlm_path, lr_schedule="warmup_linear",
+        ckpt_name="pretrained.msgpack", **common))
+
+    from pdnlp_tpu.data.tokenizer import get_or_build_vocab
+    from pdnlp_tpu.parallel import make_mesh, setup_sharded_model
+
+    vocab_size = len(get_or_build_vocab(Args(**common)))
+    mesh = make_mesh()
+    ft = Args(init_from=sft_path, init_head=True, **common)
+    cfg, tx, state, _ = setup_sharded_model(ft, vocab_size, mesh, "dp")
+
+    import flax.serialization as ser
+
+    with open(sft_path, "rb") as f:
+        saved = ser.msgpack_restore(f.read())
+    for tree in ("pooler", "classifier"):
+        assert tree in saved
+        np.testing.assert_array_equal(
+            np.asarray(state["params"][tree]["kernel"]),
+            np.asarray(saved[tree]["kernel"]))
+    # trunk came through the stage too (sft continued from the MLM encoder)
+    np.testing.assert_array_equal(
+        np.asarray(state["params"]["embeddings"]["word"]),
+        np.asarray(saved["embeddings"]["word"]))
+
+    # default (trunk-only) load leaves the head fresh: classifier differs
+    ft_fresh = Args(init_from=sft_path, **common)
+    _, _, fresh_state, _ = setup_sharded_model(ft_fresh, vocab_size, mesh, "dp")
+    assert not np.array_equal(
+        np.asarray(fresh_state["params"]["classifier"]["kernel"]),
+        np.asarray(saved["classifier"]["kernel"]))
+
+    # MLM checkpoints carry no classifier: head=True must fail loudly
+    with pytest.raises(ValueError, match="init_head"):
+        load_encoder(mlm_path, state["params"], head=True)
 
 
 def test_packed_loader_epochs_differ():
