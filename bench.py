@@ -84,11 +84,12 @@ def main() -> None:
     # fuse_steps stays 1: K-step scan fusion is math-identical but measured
     # SLOWER on this shape (scan-carried weights lose XLA layout/fusion
     # freedom); it remains a CLI knob for dispatch-bound deployments.
-    # Recipe (scripts/sweep_recipe*.py sweeps): 2 fine-tune epochs with
-    # linear warmup->decay at 3e-5, best-of-epoch checkpointing (the
-    # reference's own eval-every-50-steps keep-the-best ritual) — measured
-    # 0.520 dev accuracy from the mlm_prob=0.3 pretrain vs 0.4875 for the
-    # reference's exact 1-epoch constant-LR recipe on the same weights.
+    # Recipe (scripts/sweep_recipe*.py + sweep_sft.py sweeps): 2 fine-tune
+    # epochs with linear warmup->decay at 3e-5, trained head restored
+    # (init_head), best-of-epoch checkpointing (the reference's own
+    # eval-every-50-steps keep-the-best ritual) — measured 0.5787 dev
+    # accuracy from the MLM+sft5 pretrain (vs the reference's pretrained
+    # 0.57, and 0.5763 under its exact 1-epoch constant-LR protocol).
     args = parse_cli(base=Args(
         strategy="dp", dtype="bfloat16",
         epochs=2, lr_schedule="warmup_linear",
@@ -102,19 +103,33 @@ def main() -> None:
         pretrain_ckpt = args.ckpt_path("pretrained.msgpack")
         explicit_init = bool(args.init_from)
         if not os.path.exists(pretrain_ckpt) and not args.init_from:
-            # one-time in-repo pretraining (the "download weights" analog)
+            # one-time in-repo pretraining (the "download weights" analog):
+            # MLM over the packed corpus, then the supervised stage over the
+            # ~30k labeled externals (sweep_sft.py measured 5 epochs best)
             try:
-                from pdnlp_tpu.train.pretrain import run_pretrain
+                from pdnlp_tpu.train.pretrain import (
+                    run_pretrain, run_supervised_stage,
+                )
 
-                run_pretrain(args.replace(
-                    strategy="pretrain", train_batch_size=64, epochs=150,
-                    learning_rate=2e-4, mlm_prob=0.3, dev=False,
-                    lr_schedule=None, ckpt_name="pretrained.msgpack"))
+                mlm = args.ckpt_path("pretrained-mlm.msgpack")
+                if not os.path.exists(mlm):
+                    # a prior run's phase-1 artifact is reusable as-is: a
+                    # supervised-stage failure must not cost the ~25-min
+                    # MLM rerun on the next invocation
+                    mlm = run_pretrain(args.replace(
+                        strategy="pretrain", train_batch_size=64, epochs=150,
+                        learning_rate=2e-4, mlm_prob=0.3, dev=False,
+                        lr_schedule=None, ckpt_name="pretrained-mlm.msgpack"))
+                run_supervised_stage(args.replace(
+                    strategy="sft", init_from=mlm, init_head=False,
+                    epochs=args.sft_epochs or 5, learning_rate=args.sft_lr,
+                    lr_schedule="warmup_linear", train_batch_size=32,
+                    dev=False, ckpt_name="pretrained.msgpack"))
             except Exception as e:  # bench must still produce its JSON line
                 print(f"pretrain stage failed ({type(e).__name__}: {e}); "
                       "benching from-scratch weights", file=sys.stderr)
         if os.path.exists(pretrain_ckpt) and not args.init_from:
-            args = args.replace(init_from=pretrain_ckpt)
+            args = args.replace(init_from=pretrain_ckpt, init_head=True)
 
         try:
             trainer, train_loader, dev_loader = build_parallel_trainer(args, mode="dp")
@@ -124,10 +139,26 @@ def main() -> None:
             # from a different --model must not kill the JSON line)
             if explicit_init or not args.init_from:
                 raise
-            print(f"init_from {args.init_from!r} failed ({type(e).__name__}: "
-                  f"{e}); benching from-scratch weights", file=sys.stderr)
-            args = args.replace(init_from=None)
-            trainer, train_loader, dev_loader = build_parallel_trainer(args, mode="dp")
+            retries = []
+            if args.init_head:
+                # an MLM-only cache has no trained classifier: still a
+                # valid trunk warm-start
+                retries.append((args.replace(init_head=False),
+                                "retrying trunk-only"))
+            retries.append((args.replace(init_from=None, init_head=False),
+                            "benching from-scratch weights"))
+            for cand, action in retries:
+                print(f"init_from {args.init_from!r} failed "
+                      f"({type(e).__name__}: {e}); {action}", file=sys.stderr)
+                try:
+                    args = cand
+                    trainer, train_loader, dev_loader = \
+                        build_parallel_trainer(args, mode="dp")
+                    break
+                except Exception as e2:
+                    e = e2
+            else:
+                raise e
         # compile outside the timer (the reference times a warm CUDA context)
         host_batch = next(iter(train_loader))
         batch = trainer.put(host_batch)
@@ -189,10 +220,12 @@ def main() -> None:
         "dtype": args.dtype,
         "fuse_steps": args.fuse_steps,
         "init_from": args.init_from,
-        "note": ("fine-tuned from in-repo MLM pretrain over the 40k-text "
-                 "corpus (no egress: the reference's pretrained-checkpoint "
-                 "download is rebuilt as a pretraining stage); reference "
-                 "dev acc target 0.57" if args.init_from else
+        "note": ("fine-tuned from in-repo two-phase pretrain (MLM over the "
+                 "40k-text corpus + supervised stage over the ~30k labeled "
+                 "examples outside the protocol's [:10000] slice; no egress "
+                 "— the reference's pretrained-checkpoint download is "
+                 "rebuilt in-repo); reference dev acc target 0.57"
+                 if args.init_from else
                  "from-scratch weights; reference dev acc 0.57 is from a "
                  "pretrained model"),
     }))

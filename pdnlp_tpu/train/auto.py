@@ -52,6 +52,7 @@ class TrainerArgs:
     mode: str = "dp"                      # "zero" = the DeepSpeed delegation
     model: str = "bert-base"
     init_from: Optional[str] = None       # model_name_or_path analog (pretrain ckpt)
+    init_head: bool = False               # restore the supervised-stage head too
     data_path: str = "/root/reference/data/train.json"
     data_limit: int = 10_000
     max_seq_len: int = 128
@@ -74,6 +75,7 @@ class TrainerArgs:
             data_limit=self.data_limit,
             max_seq_len=self.max_seq_len,
             init_from=self.init_from,
+            init_head=self.init_head,
         )
 
 

@@ -328,6 +328,14 @@ def load_encoder(path: str, params, head: bool = False):
 
     with open(path, "rb") as f:
         restored = ser.msgpack_restore(f.read())
+    if head and "mlm" in restored:
+        # an 'mlm' tree marks an MLM-stage artifact; legacy ones also carry
+        # the fresh-init pooler/classifier, which must not masquerade as a
+        # trained head
+        raise ValueError(
+            f"{path!r} is an MLM-stage artifact (has an 'mlm' tree) — "
+            "--init_head needs a supervised-stage checkpoint; its "
+            "pooler/classifier were never trained")
     keys = ("embeddings", "layers") + (("pooler", "classifier") if head else ())
     out = dict(params)
     for key in keys:

@@ -21,22 +21,22 @@ CKPT = "output/pretrained.msgpack"
 # (name, argv, env overrides, expected checkpoint)
 RUNS = [
     ("single", [sys.executable, "single-tpu-cls.py",
-                "--init_from", CKPT], {}, "output/single-cls.msgpack"),
+                "--init_from", CKPT, "--init_head", "true"], {}, "output/single-cls.msgpack"),
     ("dataparallel", [sys.executable, "multi-tpu-dataparallel-cls.py",
-                      "--init_from", CKPT], {}, "output/dataparallel-cls.msgpack"),
+                      "--init_from", CKPT, "--init_head", "true"], {}, "output/dataparallel-cls.msgpack"),
     ("dp (DDP analog)", [sys.executable, "multi-tpu-jax-cls.py",
-                         "--init_from", CKPT], {}, "output/dp-cls.msgpack"),
+                         "--init_from", CKPT, "--init_head", "true"], {}, "output/dp-cls.msgpack"),
     ("amp (bf16)", [sys.executable, "multi-tpu-amp-cls.py",
-                    "--init_from", CKPT], {}, "output/amp-cls.msgpack"),
+                    "--init_from", CKPT, "--init_head", "true"], {}, "output/amp-cls.msgpack"),
     ("shardmap (Horovod analog)", [sys.executable, "multi-tpu-shardmap-cls.py",
-                                   "--init_from", CKPT], {},
+                                   "--init_from", CKPT, "--init_head", "true"], {},
      "output/shardmap-cls.msgpack"),
     ("zero (ZeRO-3 analog)", [sys.executable, "multi-tpu-zero-cls.py",
-                              "--init_from", CKPT], {}, "output/zero-cls.msgpack"),
+                              "--init_from", CKPT, "--init_head", "true"], {}, "output/zero-cls.msgpack"),
     ("accelerate", [sys.executable, "multi-tpu-accelerate-cls.py",
-                    "--init_from", CKPT], {}, "output/accelerate-cls.msgpack"),
+                    "--init_from", CKPT, "--init_head", "true"], {}, "output/accelerate-cls.msgpack"),
     ("trainer (HF Trainer analog)", [sys.executable, "multi-tpu-trainer-cls.py",
-                                     "--bf16", "true", "--init_from", CKPT], {},
+                                     "--bf16", "true", "--init_from", CKPT, "--init_head", "true"], {},
      None),
     # the spawn launcher forks real processes; on the one-chip image it runs
     # on the CPU backend with 2 processes x 4 virtual devices (the same
