@@ -93,6 +93,7 @@ def main() -> None:
     args = parse_cli(base=Args(
         strategy="dp", dtype="bfloat16",
         epochs=2, lr_schedule="warmup_linear",
+        sft_epochs=5,        # measured best; --sft_epochs 0 = MLM-only warm start
         dev=True, eval_step=50,  # eval in-loop, keep best (reference protocol)
         log_every=10 ** 9,   # no per-step printing inside the timed loop
     ))
@@ -101,35 +102,56 @@ def main() -> None:
         import numpy as np
 
         pretrain_ckpt = args.ckpt_path("pretrained.msgpack")
+        mlm_ckpt = args.ckpt_path("pretrained-mlm.msgpack")
         explicit_init = bool(args.init_from)
         if not os.path.exists(pretrain_ckpt) and not args.init_from:
             # one-time in-repo pretraining (the "download weights" analog):
             # MLM over the packed corpus, then the supervised stage over the
-            # ~30k labeled externals (sweep_sft.py measured 5 epochs best)
+            # ~30k labeled externals (sweep_sft.py measured 5 epochs best;
+            # --sft_epochs 0 stops after the MLM phase)
             try:
                 from pdnlp_tpu.train.pretrain import (
                     run_pretrain, run_supervised_stage,
                 )
 
-                mlm = args.ckpt_path("pretrained-mlm.msgpack")
-                if not os.path.exists(mlm):
-                    # a prior run's phase-1 artifact is reusable as-is: a
-                    # supervised-stage failure must not cost the ~25-min
-                    # MLM rerun on the next invocation
-                    mlm = run_pretrain(args.replace(
+                if args.sft_epochs > 0:
+                    if not os.path.exists(mlm_ckpt):
+                        # a prior run's phase-1 artifact is reusable as-is:
+                        # a supervised-stage failure must not cost the
+                        # ~25-min MLM rerun on the next invocation
+                        run_pretrain(args.replace(
+                            strategy="pretrain", train_batch_size=64,
+                            epochs=150, learning_rate=2e-4, mlm_prob=0.3,
+                            dev=False, lr_schedule=None,
+                            ckpt_name="pretrained-mlm.msgpack"))
+                    run_supervised_stage(args.replace(
+                        strategy="sft", init_from=mlm_ckpt, init_head=False,
+                        epochs=args.sft_epochs, learning_rate=args.sft_lr,
+                        lr_schedule="warmup_linear", train_batch_size=32,
+                        dev=False, ckpt_name="pretrained.msgpack"))
+                else:
+                    run_pretrain(args.replace(
                         strategy="pretrain", train_batch_size=64, epochs=150,
                         learning_rate=2e-4, mlm_prob=0.3, dev=False,
-                        lr_schedule=None, ckpt_name="pretrained-mlm.msgpack"))
-                run_supervised_stage(args.replace(
-                    strategy="sft", init_from=mlm, init_head=False,
-                    epochs=args.sft_epochs or 5, learning_rate=args.sft_lr,
-                    lr_schedule="warmup_linear", train_batch_size=32,
-                    dev=False, ckpt_name="pretrained.msgpack"))
+                        lr_schedule=None, ckpt_name="pretrained.msgpack"))
             except Exception as e:  # bench must still produce its JSON line
-                print(f"pretrain stage failed ({type(e).__name__}: {e}); "
-                      "benching from-scratch weights", file=sys.stderr)
-        if os.path.exists(pretrain_ckpt) and not args.init_from:
-            args = args.replace(init_from=pretrain_ckpt, init_head=True)
+                print(f"pretrain stage failed ({type(e).__name__}: {e})",
+                      file=sys.stderr)
+        if not args.init_from:
+            if os.path.exists(pretrain_ckpt):
+                # MLM-only artifacts ('mlm' tree, no classifier) fail the
+                # init_head load loudly; the retry ladder below drops to
+                # trunk-only for them
+                args = args.replace(init_from=pretrain_ckpt, init_head=True)
+            elif os.path.exists(mlm_ckpt):
+                # phase 2 failed but the MLM trunk survives: still a far
+                # better warm start than from-scratch weights
+                print(f"supervised stage unavailable; warm-starting from "
+                      f"the MLM trunk {mlm_ckpt}", file=sys.stderr)
+                args = args.replace(init_from=mlm_ckpt, init_head=False)
+            else:
+                print("no pretrain artifact; benching from-scratch weights",
+                      file=sys.stderr)
 
         try:
             trainer, train_loader, dev_loader = build_parallel_trainer(args, mode="dp")

@@ -1,0 +1,29 @@
+"""Tensor-parallel training over a (data x model) mesh — Megatron-style
+layer sharding with XLA-inserted block collectives.
+
+No reference twin exists (``/root/reference`` has no tensor parallelism —
+``SURVEY.md`` §2.3 lists ZeRO-3 as its only model-state sharding): this
+entrypoint is a capability the TPU framework adds.  Attention heads and MLP
+features split across the ``model`` axis (q/k/v/up shard output features,
+o/down shard input features), so each device holds 1/M of every layer's
+weights and XLA places the two per-block all-reduces exactly where Megatron
+puts its NCCL calls.  Composes with data parallelism: gradients all-reduce
+over ``data``, activations stay feature-sharded inside a block.  The
+classification task stays byte-compatible with every other strategy.
+
+On the short-sequence BERT-base task this is a scale demonstration (its
+natural use is models whose layers do not fit one device); loss parity with
+dp is pinned by ``tests/test_parallel.py``.
+
+    python multi-tpu-tp-cls.py --mesh_shape '{"data": 2, "model": 4}'
+"""
+from pdnlp_tpu.train.run import run_parallel
+from pdnlp_tpu.utils.config import Args, parse_cli
+
+if __name__ == "__main__":
+    import jax
+
+    args = parse_cli(base=Args(strategy="tp"))
+    if args.mesh_shape is None:
+        args = args.replace(mesh_shape={"data": 1, "model": len(jax.devices())})
+    run_parallel(args, mode="tp")

@@ -48,6 +48,16 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
 
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
                      dropout=args.dropout, attn_dropout=args.attn_dropout)
+    if mode == "tp":
+        from pdnlp_tpu.parallel.sharding import MODEL_AXIS
+
+        m = mesh.shape.get(MODEL_AXIS, 1)
+        if cfg.num_heads % m or cfg.intermediate_size % m:
+            raise ValueError(
+                f"tensor-parallel degree {m} must divide num_heads "
+                f"({cfg.num_heads}) and intermediate_size "
+                f"({cfg.intermediate_size}) — heads and MLP features split "
+                "across the model axis")
     root = set_seed(args.seed)
     init_key, _ = jax.random.split(root)
     train_rng = train_key(args.seed, getattr(args, "rng_impl", "rbg"))

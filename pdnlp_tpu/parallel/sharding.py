@@ -1,6 +1,7 @@
 """Sharding rules: how state and batches are laid out over the mesh.
 
-Two modes mirror the reference's two model-state strategies:
+Two modes mirror the reference's two model-state strategies, and a third
+goes beyond it:
 
 - ``"dp"`` — replicated params/optimizer, batch split along ``data``: the
   DDP analog (``/root/reference/multi-gpu-distributed-cls.py:340-341``).
@@ -10,10 +11,21 @@ Two modes mirror the reference's two model-state strategies:
   232-239`` — ``allgather_partitions`` / ``reduce_scatter`` become XLA's
   all-gather-before-use / reduce-scatter-of-grads, chosen by the compiler
   from the same one-line sharding annotation).
+- ``"tp"`` — Megatron-style tensor parallelism over a second ``model``
+  mesh axis (no reference twin: ``SURVEY.md`` §2.3 notes the reference has
+  no tensor parallelism).  Attention q/k/v and the MLP up-projection shard
+  their *output* features (heads split across devices); the o/down
+  projections shard their *input* features, so each device contracts its
+  local features and XLA inserts the block all-reduce exactly where
+  Megatron puts its NCCL call.  Composes with ``data``: grads all-reduce
+  over ``data``, activations stay sharded over ``model`` inside a block.
 
 The leaf rule for ``zero`` is shape-only — shard the largest dimension
 divisible by the axis size — so it applies uniformly to params, Adam moments,
-and anything else in the state pytree without a name registry.
+and anything else in the state pytree without a name registry.  ``tp`` is
+necessarily name-aware (which feature dim shards is semantic, not a shape
+property); its rule keys on the trailing dict path (``layers/<sub>/<leaf>``),
+which the Adam moments share with the params they mirror.
 """
 from __future__ import annotations
 
@@ -25,7 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pdnlp_tpu.parallel.mesh import DATA_AXIS
 
-MODES = ("dp", "zero")
+MODEL_AXIS = "model"
+MODES = ("dp", "zero", "tp")
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -50,26 +63,61 @@ def _zero_spec(shape, axis_size: int, axis: str) -> P:
     return P()
 
 
+def _tp_spec(names, axis: str) -> P:
+    """Megatron placement by trailing dict path ``(..., 'layers', sub, leaf)``.
+
+    Stacked layer weights are ``[L, in, out]`` (``models/bert.py``):
+    q/k/v/up shard ``out`` (column-parallel — heads / mlp features split),
+    o/down shard ``in`` (row-parallel — XLA all-reduces the partial
+    contraction).  Everything else (LN, embeddings, pooler, classifier,
+    biases of row-parallel layers) replicates."""
+    if len(names) >= 3 and names[-3] == "layers":
+        sub, leaf = names[-2], names[-1]
+        if sub in ("q", "k", "v", "up"):
+            return P(None, None, axis) if leaf == "kernel" else P(None, axis)
+        if sub in ("o", "down") and leaf == "kernel":
+            return P(None, axis, None)
+    return P()
+
+
 def state_shardings(state_shapes: Any, mesh: Mesh, mode: str = "dp",
                     axis: str = DATA_AXIS) -> Any:
     """Pytree of ``NamedSharding`` matching ``state_shapes`` (arrays or
     ``jax.eval_shape`` structs).  ``dp`` replicates everything; ``zero``
-    shards every floating leaf by the shape rule."""
+    shards every floating leaf by the shape rule; ``tp`` shards layer
+    weights over the ``model`` axis by the Megatron name rule."""
     if mode not in MODES:
         raise ValueError(f"unknown sharding mode {mode!r}; use one of {MODES}")
-    size = mesh.shape[axis]
+    if mode == "tp" and MODEL_AXIS not in mesh.shape:
+        raise ValueError(
+            f"tp needs a {MODEL_AXIS!r} mesh axis; got {dict(mesh.shape)} — "
+            'pass --mesh_shape \'{"data": D, "model": M}\'')
 
-    def rule(leaf):
-        if mode == "dp":
-            return replicated(mesh)
+    def _is_float(leaf) -> bool:
         import jax.numpy as jnp
 
         dtype = getattr(leaf, "dtype", None)
         try:
-            is_float = dtype is not None and jnp.issubdtype(dtype, jnp.floating)
+            return dtype is not None and jnp.issubdtype(dtype, jnp.floating)
         except TypeError:  # extended dtypes (PRNG keys)
-            is_float = False
-        if not is_float:
+            return False
+
+    if mode == "tp":
+        def tp_rule(path, leaf):
+            if not _is_float(leaf):
+                return replicated(mesh)
+            names = [k.key for k in path
+                     if isinstance(k, jax.tree_util.DictKey)]
+            return NamedSharding(mesh, _tp_spec(names, MODEL_AXIS))
+
+        return jax.tree_util.tree_map_with_path(tp_rule, state_shapes)
+
+    size = mesh.shape[axis]  # zero's shard axis; dp/tp never read it
+
+    def rule(leaf):
+        if mode == "dp":
+            return replicated(mesh)
+        if not _is_float(leaf):
             # ints, PRNG keys, counters: tiny — replicate
             return replicated(mesh)
         return NamedSharding(mesh, _zero_spec(leaf.shape, size, axis))

@@ -52,11 +52,11 @@ def build_parallel_trainer(
         proc0 = init_runtime(args)[0] == 0  # noqa: F841  (rendezvous side effect)
         mesh = make_mesh(num_devices=args.num_devices, shape=args.mesh_shape)
     if getattr(args, "offload_opt_state", False) and (
-            explicit_collectives or args.fuse_steps > 1):
-        raise ValueError("--offload_opt_state works with the jit strategies "
-                         "(dp/zero), not shard_map or fused multi-steps — "
-                         "the staged host<->device transfers are only wired "
-                         "into the plain train step")
+            explicit_collectives or args.fuse_steps > 1 or mode == "tp"):
+        raise ValueError("--offload_opt_state works with the jit dp/zero "
+                         "strategies, not shard_map, fused multi-steps, or "
+                         "tp — the staged host<->device transfers are only "
+                         "wired into the plain data-axis train step")
     mult = local_batch_mult(mesh) if scale_batch else 1
     train_loader, dev_loader, tok = setup_data(
         args,

@@ -82,7 +82,12 @@ class Args:
                                                   # (flat compile time)
     fuse_steps: int = 1                           # K optimizer steps per dispatch
     num_devices: Optional[int] = None             # cap mesh size (None = all)
-    mesh_shape: Optional[dict] = None             # e.g. {"dp": 2, "tp": 2, "sp": 2}
+    mesh_shape: Optional[dict] = None             # axis name -> size, -1 infers
+                                                  # one; the framework shards
+                                                  # over "data" (all
+                                                  # strategies), "seq" (sp),
+                                                  # and "model" (tp), e.g.
+                                                  # {"data": 2, "model": 4}
     prefetch: int = 2                             # host->device pipeline depth
     log_every: int = 1
     profile_dir: Optional[str] = None             # jax.profiler trace output
