@@ -1,0 +1,57 @@
+"""Pipeline-parallel training over a ``stage`` mesh axis — GPipe-style
+microbatched stages with XLA ``ppermute`` activation transfers.
+
+No reference twin exists (``/root/reference`` has no pipeline parallelism —
+``SURVEY.md`` §2.3 lists ZeRO-3 as its only model-state sharding): this
+entrypoint completes the framework's parallelism quartet (data / tensor /
+sequence / pipeline).  Each stage holds ``num_layers / S`` contiguous
+layers; the batch splits into ``--microbatches`` microbatches that flow
+through the stages in one SPMD pipelined loop (backward is ``jax.grad``
+through the loop — the reversed pipeline).  The classification task stays
+byte-compatible with every other strategy; loss/param parity with dp is
+pinned by ``tests/test_parallel.py``.
+
+On a 12-layer BERT the natural degrees are S ∈ {2, 3, 4, 6, 12}.
+
+    python multi-tpu-pp-cls.py --mesh_shape '{"stage": 4}' --microbatches 8
+"""
+import jax
+
+from pdnlp_tpu.data.corpus import LABELS
+from pdnlp_tpu.parallel import init_runtime, make_mesh
+from pdnlp_tpu.parallel.pp import (
+    STAGE, make_pp_batch, make_pp_eval_step, make_pp_train_step, setup_pp_model,
+)
+from pdnlp_tpu.train.setup import setup_data
+from pdnlp_tpu.train.trainer import Trainer
+from pdnlp_tpu.utils.config import Args, parse_cli
+from pdnlp_tpu.utils.logging import rank0_print
+from pdnlp_tpu.utils.metrics import classification_report
+
+
+def main(args: Args) -> float:
+    init_runtime(args)
+    shape = args.mesh_shape or {STAGE: len(jax.devices())}
+    mesh = make_mesh(num_devices=args.num_devices, shape=shape)
+    train_loader, dev_loader, tok = setup_data(args)
+    cfg, tx, state, _ = setup_pp_model(
+        args, tok.vocab_size, mesh,
+        total_steps=len(train_loader) * args.epochs)
+    train_step = make_pp_train_step(cfg, tx, args, mesh,
+                                    n_micro=args.microbatches)
+    eval_step = make_pp_eval_step(cfg, args, mesh, n_micro=args.microbatches)
+    trainer = Trainer(args, cfg, state, train_step, eval_step,
+                      put=make_pp_batch(mesh))
+    rank0_print(f"mesh: {dict(mesh.shape)}  stages: {mesh.shape[STAGE]} x "
+                f"{cfg.num_layers // mesh.shape[STAGE]} layers  "
+                f"microbatches: {args.microbatches}  "
+                f"steps/epoch: {len(train_loader)}")
+    minutes = trainer.train(train_loader, dev_loader)
+    result = trainer.test(dev_loader)
+    rank0_print(f"test loss：{result['loss']:.6f} accuracy：{result['accuracy']:.4f}")
+    rank0_print(classification_report(result["y_true"], result["y_pred"], LABELS))
+    return minutes
+
+
+if __name__ == "__main__":
+    main(parse_cli(base=Args(strategy="pp")))

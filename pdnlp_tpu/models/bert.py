@@ -151,17 +151,11 @@ def encode(
         raise ValueError(
             f"sequence length {S} exceeds max_position {cfg.max_position}; "
             "JAX gather would silently clamp position embeddings")
-    emb = params["embeddings"]
-    x = (
-        emb["word"][input_ids]
-        + emb["position"][jnp.arange(S) + shard_offset]
-        + emb["token_type"][token_type_ids]
-    ).astype(dtype)
-    x = _layer_norm(x, emb["ln"]["scale"], emb["ln"]["bias"], cfg.layer_norm_eps)
-    if not deterministic:
-        rng, k = jax.random.split(rng)
-        x = _dropout(x, cfg.dropout, k)
+    x, rng = embed(params, cfg, input_ids, token_type_ids, dtype=dtype,
+                   deterministic=deterministic, rng=rng,
+                   shard_offset=shard_offset)
 
+    ring_bias = bias = None
     if attn_bias is not None:
         if seq_axis is not None:
             raise ValueError("attn_bias override is not supported on the "
@@ -173,11 +167,55 @@ def encode(
         # same additive-mask semantics, squeezed to the [B, S_local] rows the
         # ring rotates alongside KV
         ring_bias = mask_bias(attention_mask, jnp.float32)[:, 0, 0, :]
+    return run_layers(
+        params["layers"], cfg, x, li=jnp.arange(cfg.num_layers), bias=bias,
+        ring_bias=ring_bias, dtype=dtype, deterministic=deterministic,
+        rng=rng, remat=remat, attn_impl=attn_impl, seq_axis=seq_axis,
+        unroll=unroll,
+    )
+
+
+def embed(params: Params, cfg: BertConfig, input_ids: jax.Array,
+          token_type_ids: jax.Array, *, dtype=jnp.float32,
+          deterministic: bool = True, rng: Optional[jax.Array] = None,
+          shard_offset=0):
+    """Embedding sum + LayerNorm + dropout; returns ``(x, rng)`` with the
+    embedding dropout's split consumed, so layer streams continue from the
+    returned key exactly as they did when this lived inline in ``encode``.
+    Public so the pipeline-parallel path can run it on its first stage."""
+    S = input_ids.shape[1]
+    emb = params["embeddings"]
+    x = (
+        emb["word"][input_ids]
+        + emb["position"][jnp.arange(S) + shard_offset]
+        + emb["token_type"][token_type_ids]
+    ).astype(dtype)
+    x = _layer_norm(x, emb["ln"]["scale"], emb["ln"]["bias"], cfg.layer_norm_eps)
+    if not deterministic:
+        rng, k = jax.random.split(rng)
+        x = _dropout(x, cfg.dropout, k)
+    return x, rng
+
+
+def run_layers(layers: Params, cfg: BertConfig, x: jax.Array, *,
+               li: jax.Array, bias: Optional[jax.Array] = None,
+               ring_bias: Optional[jax.Array] = None, dtype=jnp.float32,
+               deterministic: bool = True, rng: Optional[jax.Array] = None,
+               remat: bool = False, attn_impl: str = "xla",
+               seq_axis: Optional[str] = None, unroll=True) -> jax.Array:
+    """Scan a stacked slice of encoder layers over ``x`` ([B, S, H]).
+
+    ``layers`` holds leading-dim-stacked weights (any contiguous slice of
+    the stack) and ``li`` the matching *global* layer indices — dropout
+    streams key on the global index, so a pipeline stage running layers
+    [k..2k) reproduces exactly the streams the full stack would.  Public so
+    the pipeline-parallel path can run per-stage slices."""
+    B, S = x.shape[0], x.shape[1]
     N, D = cfg.num_heads, cfg.head_dim
 
     def layer(carry, scanned):
         x, rng = carry
-        lp, li = scanned
+        lp, idx = scanned
 
         def heads(t):
             return t.reshape(B, S, N, D)
@@ -193,18 +231,18 @@ def encode(
             attn = dot_product_attention(
                 q, k, v, bias, impl=attn_impl,
                 dropout_rate=0.0 if deterministic else cfg.attn_dropout,
-                dropout_rng=None if deterministic else jax.random.fold_in(rng, 3 * li + 2),
+                dropout_rng=None if deterministic else jax.random.fold_in(rng, 3 * idx + 2),
             )
         attn = _dense(attn.reshape(B, S, N * D), lp["o"], dtype)
         if not deterministic:
-            attn = _dropout(attn, cfg.dropout, jax.random.fold_in(rng, 3 * li))
+            attn = _dropout(attn, cfg.dropout, jax.random.fold_in(rng, 3 * idx))
         x = _layer_norm(x + attn, lp["attn_ln"]["scale"], lp["attn_ln"]["bias"],
                         cfg.layer_norm_eps)
 
         h = jax.nn.gelu(_dense(x, lp["up"], dtype), approximate=False)
         h = _dense(h, lp["down"], dtype)
         if not deterministic:
-            h = _dropout(h, cfg.dropout, jax.random.fold_in(rng, 3 * li + 1))
+            h = _dropout(h, cfg.dropout, jax.random.fold_in(rng, 3 * idx + 1))
         x = _layer_norm(x + h, lp["mlp_ln"]["scale"], lp["mlp_ln"]["bias"],
                         cfg.layer_norm_eps)
         return (x, rng), None
@@ -214,10 +252,7 @@ def encode(
 
     if rng is None:
         rng = jax.random.key(0)  # unused when deterministic
-    (x, _), _ = jax.lax.scan(
-        layer, (x, rng), (params["layers"], jnp.arange(cfg.num_layers)),
-        unroll=unroll,
-    )
+    (x, _), _ = jax.lax.scan(layer, (x, rng), (layers, li), unroll=unroll)
     return x
 
 
@@ -285,8 +320,18 @@ def classify(
     if seq_axis is not None:
         on_shard0 = (jax.lax.axis_index(seq_axis) == 0).astype(h0.dtype)
         h0 = jax.lax.psum(h0 * on_shard0, seq_axis)
+    return pooled_logits(params, cfg, h0, dtype=dtype,
+                         drop_rng=None if deterministic else drop_rng)
+
+
+def pooled_logits(params: Params, cfg: BertConfig, h0: jax.Array, *,
+                  dtype=jnp.float32, drop_rng=None) -> jax.Array:
+    """[CLS] hidden rows [B, H] -> logits [B, num_labels] (fp32): tanh
+    pooler, optional dropout (``drop_rng`` given), classifier.  Shared by
+    ``classify`` and the pipeline-parallel path so the head cannot drift
+    between them."""
     pooled = jnp.tanh(_dense(h0, params["pooler"], dtype))
-    if not deterministic:
+    if drop_rng is not None:
         pooled = _dropout(pooled, cfg.dropout, drop_rng)
     logits = _dense(pooled, params["classifier"], dtype)
     return logits.astype(jnp.float32)

@@ -82,6 +82,9 @@ class Args:
                                                   # (flat compile time)
     fuse_steps: int = 1                           # K optimizer steps per dispatch
     num_devices: Optional[int] = None             # cap mesh size (None = all)
+    microbatches: int = 4                         # pipeline (pp) microbatch
+                                                  # count; bubble is
+                                                  # (S-1)/(M+S-1)
     mesh_shape: Optional[dict] = None             # axis name -> size, -1 infers
                                                   # one; the framework shards
                                                   # over "data" (all

@@ -177,6 +177,62 @@ def test_tp_rejects_bad_degree_and_missing_axis(ndev):
         setup_sharded_model(args, VOCAB, mesh, "tp")
 
 
+def test_pp_matches_dp_and_shards_stages(ndev):
+    """Pipeline parallelism (no reference twin): GPipe microbatching over a
+    'stage' mesh axis reproduces the dp loss/params, each stage holds its
+    slice of the layer stack, and the eval step keeps the metric contract."""
+    from pdnlp_tpu.parallel.pp import (
+        make_pp_batch, make_pp_eval_step, make_pp_train_step, setup_pp_model,
+    )
+
+    args = tiny_args()
+    batches = [fake_batch(16, seed=s) for s in range(3)]
+
+    mesh_dp = make_mesh(shape={"data": ndev})
+    cfg, tx, st, sh = setup_sharded_model(args, VOCAB, mesh_dp, "dp")
+    step = make_parallel_train_step(cfg, tx, args, mesh_dp, sh)
+    put = make_global_batch(mesh_dp)
+    for b in batches:
+        st, m_dp = step(st, put(b))
+
+    pmesh = make_mesh(shape={"stage": 2})  # bert-tiny: 2 layers, 1 per stage
+    cfg2, tx2, st2, _ = setup_pp_model(args, VOCAB, pmesh)
+    q = st2["params"]["layers"]["q"]["kernel"]
+    assert q.addressable_shards[0].data.shape[0] == q.shape[0] // 2
+    pstep = make_pp_train_step(cfg2, tx2, args, pmesh, n_micro=4)
+    pput = make_pp_batch(pmesh)
+    for b in batches:
+        st2, m_pp = pstep(st2, pput(b))
+    assert float(m_pp["loss"]) == pytest.approx(float(m_dp["loss"]), rel=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5),
+        jax.device_get(st["params"]), jax.device_get(st2["params"]))
+
+    ev = make_pp_eval_step(cfg2, args, pmesh, n_micro=4)
+    em = ev(st2["params"], pput(batches[0]))
+    assert float(em["weight"]) == 16.0
+    assert em["pred"].shape == (16,)
+
+    # dropout on: its own stream, but the pipeline must stay finite
+    dr_args = tiny_args(dropout=0.1, attn_dropout=0.1)
+    cfg3, tx3, st3, _ = setup_pp_model(dr_args, VOCAB, pmesh)
+    dstep = make_pp_train_step(cfg3, tx3, dr_args, pmesh, n_micro=2)
+    st3, m3 = dstep(st3, pput(batches[0]))
+    assert np.isfinite(float(m3["loss"]))
+
+
+def test_pp_rejects_bad_degree_and_missing_axis(ndev):
+    from pdnlp_tpu.parallel.pp import setup_pp_model
+
+    args = tiny_args()
+    with pytest.raises(ValueError, match="stage"):
+        setup_pp_model(args, VOCAB, make_mesh(shape={"data": ndev}))
+    # bert-tiny has 2 layers: 2 stages is the ceiling
+    with pytest.raises(ValueError, match="num_layers"):
+        setup_pp_model(args, VOCAB, make_mesh(shape={"stage": 4}))
+
+
 def test_zero_shards_state_memory(ndev):
     args = tiny_args()
     mesh = make_mesh()
