@@ -52,6 +52,24 @@ RUNS = [
       "spawn-cls.msgpack"],
      {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
      "output/spawn-cls.msgpack"),
+    # tp / pp are multi-device-only strategies: on the one-chip image they
+    # run on the virtual CPU mesh with bert-tiny as execution evidence
+    # (parity with dp is pinned by tests/test_parallel.py)
+    ("tp 4x2 data*model (CPU mesh)",
+     [sys.executable, "multi-tpu-tp-cls.py", "--model", "bert-tiny",
+      "--max_seq_len", "64", "--data_limit", "2000",
+      "--mesh_shape", '{"data": 4, "model": 2}',
+      "--log_every", "1000000", "--ckpt_name", "tp-cls.msgpack"],
+     {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+     "output/tp-cls.msgpack"),
+    ("pp 2-stage (CPU mesh)",
+     [sys.executable, "multi-tpu-pp-cls.py", "--model", "bert-tiny",
+      "--max_seq_len", "64", "--data_limit", "2000",
+      "--mesh_shape", '{"stage": 2}', "--num_devices", "2",
+      "--microbatches", "4",
+      "--log_every", "1000000", "--ckpt_name", "pp-cls.msgpack"],
+     {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+     "output/pp-cls.msgpack"),
 ]
 
 RE_MIN = re.compile(r"耗时：([\d.]+)分钟")
