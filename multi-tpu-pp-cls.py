@@ -11,9 +11,12 @@ through the loop — the reversed pipeline).  The classification task stays
 byte-compatible with every other strategy; loss/param parity with dp is
 pinned by ``tests/test_parallel.py``.
 
-On a 12-layer BERT the natural degrees are S ∈ {2, 3, 4, 6, 12}.
+On a 12-layer BERT the natural degrees are S ∈ {2, 3, 4, 6, 12}.  A
+``data`` mesh axis composes: each data shard runs its own pipeline and
+gradients weight-combine across shards (dp x pp).
 
     python multi-tpu-pp-cls.py --mesh_shape '{"stage": 4}' --microbatches 8
+    python multi-tpu-pp-cls.py --mesh_shape '{"data": 2, "stage": 4}'
 """
 import jax
 
@@ -33,7 +36,10 @@ def main(args: Args) -> float:
     init_runtime(args)
     shape = args.mesh_shape or {STAGE: len(jax.devices())}
     mesh = make_mesh(num_devices=args.num_devices, shape=shape)
-    train_loader, dev_loader, tok = setup_data(args)
+    # dp x pp composition: a "data" axis scales the global batch the same
+    # way the pure-DP strategies do (DistributedSampler step math)
+    train_loader, dev_loader, tok = setup_data(
+        args, device_batch_mult=mesh.shape.get("data", 1))
     cfg, tx, state, _ = setup_pp_model(
         args, tok.vocab_size, mesh,
         total_steps=len(train_loader) * args.epochs)

@@ -29,6 +29,18 @@ def loss_reduce(loss: jax.Array, axis: str = DATA_AXIS) -> jax.Array:
     return lax.pmean(loss, axis)
 
 
+def weighted_shard_scale(local_weight: jax.Array, axis: str = DATA_AXIS):
+    """``(scale, global_weight)`` for combining per-shard weighted means
+    into the exact global weighted mean: each shard's grad/loss (a mean
+    over its local weight mass) is multiplied by ``scale = lw/gw`` and
+    ``psum``'d.  Exact even when filler rows make shards uneven, and the
+    zero guard keeps an all-filler global batch at 0 instead of 0/0 NaN
+    (the guard ``steps.weighted_ce`` applies locally, applied globally).
+    Shared by the shard_map (Horovod-analog) and pipeline train steps."""
+    gw = jnp.maximum(lax.psum(local_weight, axis), 1.0)
+    return local_weight / gw, gw
+
+
 def grad_reduce(grads, axis: str = DATA_AXIS, compress_dtype=None):
     """Mean-reduce a gradient pytree across the data axis.
 

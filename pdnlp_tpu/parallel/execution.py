@@ -192,8 +192,9 @@ def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
         (loss, (correct, lw)), grads = jax.value_and_grad(
             local_loss, has_aux=True)(state["params"], batch, rng)
-        gw = jax.lax.psum(lw, DATA_AXIS)
-        scale = lw / gw
+        from pdnlp_tpu.parallel.collectives import weighted_shard_scale
+
+        scale, gw = weighted_shard_scale(lw, DATA_AXIS)
         grads = jax.tree_util.tree_map(
             lambda g: (jax.lax.psum((g * scale).astype(compress), DATA_AXIS)
                        .astype(g.dtype)) if compress is not None

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pdnlp_tpu.models import bert
 from pdnlp_tpu.models.config import BertConfig
+from pdnlp_tpu.parallel.mesh import DATA_AXIS
 from pdnlp_tpu.train.precision import resolve_dtype
 from pdnlp_tpu.train.steps import init_state, weighted_ce
 
@@ -193,14 +194,22 @@ def make_pp_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
     """Compile the pipelined train step.  Gradients of each stage's layer
     slice stay on that stage; gradients of the replicated trees are
     ``psum``-combined (they receive nonzero cotangents only on the stages
-    that use them — embeddings on stage 0, the head on the last)."""
+    that use them — embeddings on stage 0, the head on the last).
+
+    Composes with data parallelism: on a ``(data x stage)`` mesh the batch
+    arrives split along ``data``, each data shard runs its own pipeline,
+    and gradients weight-combine across shards exactly as the shard_map
+    (Horovod-analog) path does — the global-mean gradient stays exact even
+    when filler rows make shards uneven."""
     n_stages = mesh.shape[STAGE]
+    has_data = DATA_AXIS in mesh.shape
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
     from pdnlp_tpu.train.steps import _unroll
 
     unroll = _unroll(args)
+    batch_spec = P(DATA_AXIS) if has_data else P()
 
     def loss_fn(params, batch, rng):
         logits = _pp_logits(params, batch, cfg, n_stages=n_stages,
@@ -214,23 +223,41 @@ def make_pp_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
 
     def per_device(state: State, batch):
         rng = jax.random.fold_in(state["rng"], state["step"])
+        if has_data:  # distinct dropout stream per data shard (cf. shardmap)
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
         (loss, correct), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state["params"], batch, rng)
-        grads = {k: (v if k == "layers" else
-                     jax.tree_util.tree_map(
-                         lambda g: jax.lax.psum(g, STAGE), v))
+        if has_data:
+            # local grads are weighted means over the local shard; combine
+            # them weighted by local weight mass -> exact global mean
+            from pdnlp_tpu.parallel.collectives import weighted_shard_scale
+
+            scale, gw = weighted_shard_scale(
+                batch["example_weight"].sum(), DATA_AXIS)
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            loss = jax.lax.psum(loss * scale, DATA_AXIS)
+            correct = jax.lax.psum(correct, DATA_AXIS)
+        else:
+            gw = jnp.maximum(batch["example_weight"].sum(), 1.0)
+
+        def reduce_g(g, with_stage):
+            axes = ((DATA_AXIS,) if has_data else ()) + \
+                   ((STAGE,) if with_stage else ())
+            return jax.lax.psum(g, axes) if axes else g
+
+        grads = {k: jax.tree_util.tree_map(
+                     lambda g: reduce_g(g, with_stage=(k != "layers")), v)
                  for k, v in grads.items()}
         updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
         params = optax.apply_updates(state["params"], updates)
         new_state = {"params": params, "opt_state": opt_state,
                      "step": state["step"] + 1, "rng": state["rng"]}
-        wsum = jnp.maximum(batch["example_weight"].sum(), 1.0)
-        return new_state, {"loss": loss, "accuracy": correct / wsum}
+        return new_state, {"loss": loss, "accuracy": correct / gw}
 
     return _lazy_jit(lambda state: jax.jit(
         jax.shard_map(
             per_device, mesh=mesh,
-            in_specs=(pp_specs(state), P()),
+            in_specs=(pp_specs(state), batch_spec),
             out_specs=(pp_specs(state), P()),
             check_vma=False,
         ),
@@ -240,13 +267,19 @@ def make_pp_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
 
 def make_pp_eval_step(cfg: BertConfig, args, mesh: Mesh, n_micro: int = 4):
     """Deterministic pipelined eval step with ``build_eval_step``'s metric
-    contract (global sums + echoed preds/labels, everything replicated)."""
+    contract: global scalar sums (replicated), per-row preds/labels left
+    sharded along ``data`` (the host fetch is the all-gather)."""
     n_stages = mesh.shape[STAGE]
+    has_data = DATA_AXIS in mesh.shape
     dtype = resolve_dtype(args.dtype)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
     from pdnlp_tpu.train.steps import _unroll
 
     unroll = _unroll(args)
+    batch_spec = P(DATA_AXIS) if has_data else P()
+
+    def data_sum(x):
+        return jax.lax.psum(x, DATA_AXIS) if has_data else x
 
     def per_device(params, batch):
         logits = _pp_logits(params, batch, cfg, n_stages=n_stages,
@@ -256,29 +289,35 @@ def make_pp_eval_step(cfg: BertConfig, args, mesh: Mesh, n_micro: int = 4):
         w = batch["example_weight"]
         loss, correct = weighted_ce(logits, batch["label"], w)
         return {
-            "loss_sum": _select_last(loss * jnp.maximum(w.sum(), 1.0), n_stages),
-            "weight": w.sum(),
-            "correct": _select_last(correct, n_stages),
+            "loss_sum": data_sum(
+                _select_last(loss * jnp.maximum(w.sum(), 1.0), n_stages)),
+            "weight": data_sum(w.sum()),
+            "correct": data_sum(_select_last(correct, n_stages)),
             "pred": _select_last(jnp.argmax(logits, -1), n_stages),
             "label": batch["label"],
             "ew": w,
         }
 
+    out_specs = {"loss_sum": P(), "weight": P(), "correct": P(),
+                 "pred": batch_spec, "label": batch_spec, "ew": batch_spec}
     return _lazy_jit(lambda params: jax.jit(jax.shard_map(
         per_device, mesh=mesh,
-        in_specs=(pp_specs(params), P()),
-        out_specs=P(),
+        in_specs=(pp_specs(params), batch_spec),
+        out_specs=out_specs,
         check_vma=False,
     )))
 
 
 def make_pp_batch(mesh: Mesh):
-    """Host batch -> replicated global arrays on the pipeline mesh (every
-    stage sees the full batch; activations, not data, are what flow)."""
-    rep = NamedSharding(mesh, P())
+    """Host batch -> global arrays on the pipeline mesh: split along
+    ``data`` when that axis exists (each shard runs its own pipeline),
+    replicated across ``stage`` (activations, not data, flow stage to
+    stage)."""
+    spec = P(DATA_AXIS) if DATA_AXIS in mesh.shape else P()
+    sh = NamedSharding(mesh, spec)
 
     def put(batch):
         return jax.tree_util.tree_map(
-            lambda a: jax.device_put(np.asarray(a), rep), batch)
+            lambda a: jax.device_put(np.asarray(a), sh), batch)
 
     return put

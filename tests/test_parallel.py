@@ -214,6 +214,29 @@ def test_pp_matches_dp_and_shards_stages(ndev):
     assert float(em["weight"]) == 16.0
     assert em["pred"].shape == (16,)
 
+    # dp x pp composition: each data shard runs its own pipeline; a ragged
+    # batch (filler rows weigh 0) keeps the weighted grad combine exact
+    ragged = fake_batch(16, seed=7)
+    ragged["example_weight"][-3:] = 0.0
+    st_dp2 = st
+    for b in (ragged,):
+        st_dp2, m_dp2 = step(st_dp2, put(b))
+    cmesh = make_mesh(shape={"data": 2, "stage": 2})
+    cfg3, tx3, st3, _ = setup_pp_model(args, VOCAB, cmesh)
+    cstep = make_pp_train_step(cfg3, tx3, args, cmesh, n_micro=2)
+    cput = make_pp_batch(cmesh)
+    for b in batches + [ragged]:
+        st3, m_c = cstep(st3, cput(b))
+    assert float(m_c["loss"]) == pytest.approx(float(m_dp2["loss"]), rel=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5),  # 4 Adam steps of drift
+        jax.device_get(st_dp2["params"]), jax.device_get(st3["params"]))
+    cem = make_pp_eval_step(cfg3, args, cmesh, n_micro=2)(
+        st3["params"], cput(ragged))
+    assert float(cem["weight"]) == 13.0
+    assert np.asarray(cem["pred"]).shape == (16,)
+
     # dropout on: its own stream, but the pipeline must stay finite
     dr_args = tiny_args(dropout=0.1, attn_dropout=0.1)
     cfg3, tx3, st3, _ = setup_pp_model(dr_args, VOCAB, pmesh)
