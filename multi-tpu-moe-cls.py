@@ -1,0 +1,36 @@
+"""Mixture-of-experts training with expert parallelism over an ``expert``
+mesh axis.
+
+No reference twin exists (``/root/reference`` is dense BERT only): this
+entrypoint adds the MoE model family and the fifth parallelism flavor.
+The MLP of every layer becomes ``moe_experts`` top-k gated experts
+(``models/bert.moe_mlp``: dense dispatch — each device computes its local
+experts for all tokens and the gate-weighted combine contracts the expert
+dim, which XLA turns into the expert all-reduce under the "ep" sharding
+mode).  A Switch-style load-balancing aux loss keeps experts from
+collapsing; the reported loss stays bare CE so dense and MoE runs read on
+the same scale.  Trains from scratch (the in-repo pretrain artifact is a
+dense trunk; its MLP shapes cannot warm-start expert stacks).
+
+    python multi-tpu-moe-cls.py --mesh_shape '{"data": 2, "expert": 4}'
+"""
+from pdnlp_tpu.train.run import run_parallel
+from pdnlp_tpu.utils.config import Args, parse_cli
+
+if __name__ == "__main__":
+    import jax
+
+    from pdnlp_tpu.models import get_config
+    from pdnlp_tpu.parallel import init_runtime
+
+    args = parse_cli(base=Args(strategy="ep", model="bert-base-moe"))
+    if args.mesh_shape is None:
+        init_runtime(args)  # platform overrides must land before devices()
+        n = len(jax.devices())
+        # expert degree can't exceed the expert count; spare devices go to
+        # the data axis (1 chip -> {"data": 1, "expert": 1}, degenerate ok)
+        experts = get_config(args.model).moe_experts
+        e = next(d for d in range(min(n, experts), 0, -1)
+                 if experts % d == 0 and n % d == 0)
+        args = args.replace(mesh_shape={"data": n // e, "expert": e})
+    run_parallel(args, mode="ep")

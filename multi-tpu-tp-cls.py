@@ -23,7 +23,10 @@ from pdnlp_tpu.utils.config import Args, parse_cli
 if __name__ == "__main__":
     import jax
 
+    from pdnlp_tpu.parallel import init_runtime
+
     args = parse_cli(base=Args(strategy="tp"))
     if args.mesh_shape is None:
+        init_runtime(args)  # platform overrides must land before devices()
         args = args.replace(mesh_shape={"data": 1, "model": len(jax.devices())})
     run_parallel(args, mode="tp")

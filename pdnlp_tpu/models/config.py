@@ -27,6 +27,10 @@ class BertConfig:
     num_labels: int = 6
     initializer_range: float = 0.02
     layer_norm_eps: float = 1e-12
+    # --- mixture-of-experts (0 experts = dense MLP; no reference twin) ---
+    moe_experts: int = 0          # experts per layer's MLP
+    moe_top_k: int = 2            # experts combined per token
+    moe_aux_coef: float = 0.01    # Switch-style load-balancing loss weight
 
     @property
     def head_dim(self) -> int:
@@ -45,6 +49,12 @@ _REGISTRY = {
                              intermediate_size=2048),
     "bert-tiny": BertConfig(hidden_size=128, num_layers=2, num_heads=2,
                             intermediate_size=512, max_position=128),
+    # MoE variants: the dense MLP becomes moe_experts gated experts (the
+    # expert-parallel "ep" sharding mode splits them over an "expert" axis)
+    "bert-base-moe": BertConfig(moe_experts=4),
+    "bert-tiny-moe": BertConfig(hidden_size=128, num_layers=2, num_heads=2,
+                                intermediate_size=512, max_position=128,
+                                moe_experts=4),
 }
 
 

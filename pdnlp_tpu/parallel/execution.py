@@ -51,6 +51,9 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
     if mode == "tp":
         from pdnlp_tpu.parallel.sharding import MODEL_AXIS
 
+        if cfg.moe_experts:
+            raise ValueError("tp does not support MoE models (the expert "
+                             "dim needs the ep mode's placement)")
         m = mesh.shape.get(MODEL_AXIS, 1)
         if cfg.num_heads % m or cfg.intermediate_size % m:
             raise ValueError(
@@ -58,6 +61,17 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
                 f"({cfg.num_heads}) and intermediate_size "
                 f"({cfg.intermediate_size}) — heads and MLP features split "
                 "across the model axis")
+    if mode == "ep":
+        from pdnlp_tpu.parallel.sharding import EXPERT_AXIS
+
+        e = mesh.shape.get(EXPERT_AXIS, 1)
+        if not cfg.moe_experts:
+            raise ValueError(f"ep needs an MoE model ({args.model} is "
+                             "dense) — use bert-base-moe / bert-tiny-moe "
+                             "or set moe_experts")
+        if cfg.moe_experts % e:
+            raise ValueError(f"expert-parallel degree {e} must divide "
+                             f"moe_experts ({cfg.moe_experts})")
     root = set_seed(args.seed)
     init_key, _ = jax.random.split(root)
     train_rng = train_key(args.seed, getattr(args, "rng_impl", "rbg"))
@@ -173,6 +187,11 @@ def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
     """
     from pdnlp_tpu.train.steps import _unroll
 
+    if cfg.moe_experts:
+        raise ValueError("MoE models run on the jit strategies (dp/zero/ep)"
+                         " — the shard_map path's local loss has no aux-"
+                         "loss plumbing and would silently skip load "
+                         "balancing")
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"

@@ -82,6 +82,10 @@ def setup_pp_model(args, vocab_size: int, mesh: Mesh, total_steps: int = None
         raise ValueError(f"pipeline degree {n_stages} must divide num_layers "
                          f"({cfg.num_layers}) — stages hold contiguous "
                          "layer slices")
+    if cfg.moe_experts:
+        raise ValueError("pp does not support MoE models yet — the pipeline "
+                         "loop has no aux-loss plumbing and would silently "
+                         "skip load balancing")
     root = set_seed(args.seed)
     init_key, _ = jax.random.split(root)
     train_rng = train_key(args.seed, getattr(args, "rng_impl", "rbg"))

@@ -38,7 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pdnlp_tpu.parallel.mesh import DATA_AXIS
 
 MODEL_AXIS = "model"
-MODES = ("dp", "zero", "tp")
+EXPERT_AXIS = "expert"
+MODES = ("dp", "zero", "tp", "ep")
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -60,6 +61,23 @@ def _zero_spec(shape, axis_size: int, axis: str) -> P:
             spec = [None] * len(shape)
             spec[i] = axis
             return P(*spec)
+    return P()
+
+
+def _ep_spec(names, shape, axis: str) -> P:
+    """Expert-parallel placement: MoE expert weights ``[L, E, in, out]``
+    (and biases ``[L, E, out]``) split their expert dim; the gate and all
+    attention weights replicate.  The gate-weighted combine contracts the
+    expert dim, so XLA inserts the expert all-reduce there.  Rank-checked:
+    a dense model's rank-3 ``up``/``down`` stacks replicate (only MoE
+    models grow the expert dim)."""
+    if len(names) >= 3 and names[-3] == "layers":
+        sub, leaf = names[-2], names[-1]
+        if sub in ("up", "down"):
+            if leaf == "kernel" and len(shape) == 4:
+                return P(None, axis, None, None)
+            if leaf == "bias" and len(shape) == 3:
+                return P(None, axis, None)
     return P()
 
 
@@ -92,6 +110,10 @@ def state_shardings(state_shapes: Any, mesh: Mesh, mode: str = "dp",
         raise ValueError(
             f"tp needs a {MODEL_AXIS!r} mesh axis; got {dict(mesh.shape)} — "
             'pass --mesh_shape \'{"data": D, "model": M}\'')
+    if mode == "ep" and EXPERT_AXIS not in mesh.shape:
+        raise ValueError(
+            f"ep needs an {EXPERT_AXIS!r} mesh axis; got {dict(mesh.shape)} "
+            '— pass --mesh_shape \'{"data": D, "expert": E}\'')
 
     def _is_float(leaf) -> bool:
         import jax.numpy as jnp
@@ -102,15 +124,17 @@ def state_shardings(state_shapes: Any, mesh: Mesh, mode: str = "dp",
         except TypeError:  # extended dtypes (PRNG keys)
             return False
 
-    if mode == "tp":
-        def tp_rule(path, leaf):
+    if mode in ("tp", "ep"):
+        def name_rule(path, leaf):
             if not _is_float(leaf):
                 return replicated(mesh)
             names = [k.key for k in path
                      if isinstance(k, jax.tree_util.DictKey)]
-            return NamedSharding(mesh, _tp_spec(names, MODEL_AXIS))
+            spec = (_tp_spec(names, MODEL_AXIS) if mode == "tp"
+                    else _ep_spec(names, leaf.shape, EXPERT_AXIS))
+            return NamedSharding(mesh, spec)
 
-        return jax.tree_util.tree_map_with_path(tp_rule, state_shapes)
+        return jax.tree_util.tree_map_with_path(name_rule, state_shapes)
 
     size = mesh.shape[axis]  # zero's shard axis; dp/tp never read it
 

@@ -109,10 +109,10 @@ def build_mlm_step(cfg, tx, args, mask_id: int):
                                      cfg.vocab_size, args.mlm_prob,
                                      span=args.mlm_span)
         seg = batch["segment_ids"]
-        hidden = bert.encode(
+        hidden, aux = bert.encode(
             params, cfg, ids, jnp.zeros_like(ids), (seg > 0).astype(jnp.int32),
             dtype=dtype, deterministic=False, rng=k_drop, remat=remat,
-            attn_bias=segment_bias(seg), unroll=unroll,
+            attn_bias=segment_bias(seg), unroll=unroll, with_aux=True,
         )
         logits = bert.mlm_logits(params, params["mlm"], cfg, hidden, dtype=dtype)
         logp = jax.nn.log_softmax(logits)
@@ -120,11 +120,13 @@ def build_mlm_step(cfg, tx, args, mask_id: int):
         wsum = jnp.maximum(w.sum(), 1.0)
         loss = (ce * w).sum() / wsum
         correct = ((jnp.argmax(logits, -1) == labels) * w).sum()
-        return loss, (correct, wsum)
+        # aux (MoE load balancing; 0 for dense) joins the optimized
+        # objective only — the logged loss stays bare CE
+        return loss + cfg.moe_aux_coef * aux, (loss, correct, wsum)
 
     def train_step(state, batch):
         rng = jax.random.fold_in(state["rng"], state["step"])
-        (loss, (correct, wsum)), grads = jax.value_and_grad(
+        (_, (loss, correct, wsum)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state["params"], batch, rng)
         updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
         params = optax.apply_updates(state["params"], updates)

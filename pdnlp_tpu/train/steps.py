@@ -83,16 +83,20 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
     unroll = _unroll(args)
 
     def loss_fn(params, batch, rng):
-        logits = bert.classify(
+        # aux is the MoE load-balancing loss, a constant 0 for dense models
+        # (XLA folds the add away); it joins the optimized objective only —
+        # the reported loss stays bare CE so MoE and dense runs read on the
+        # same scale
+        logits, aux = bert.classify(
             params, cfg, batch, dtype=dtype, deterministic=False, rng=rng,
-            remat=remat, attn_impl=attn_impl, unroll=unroll,
+            remat=remat, attn_impl=attn_impl, unroll=unroll, return_aux=True,
         )
         loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"])
-        return loss, correct
+        return loss + cfg.moe_aux_coef * aux, (loss, correct)
 
     def train_step(state: State, batch: Dict[str, jax.Array]) -> Tuple[State, Metrics]:
         rng = jax.random.fold_in(state["rng"], state["step"])
-        (loss, correct), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (_, (loss, correct)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state["params"], batch, rng
         )
         opt_in = state["opt_state"]
