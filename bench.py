@@ -81,9 +81,12 @@ def main() -> None:
     from pdnlp_tpu.train.run import build_parallel_trainer
     from pdnlp_tpu.utils.config import Args, parse_cli
 
-    # fuse_steps stays 1: K-step scan fusion is math-identical but measured
-    # SLOWER on this shape (scan-carried weights lose XLA layout/fusion
-    # freedom); it remains a CLI knob for dispatch-bound deployments.
+    # fuse_steps=4: K-step scan fusion is math-identical (dev loss/accuracy
+    # bit-equal to unfused) and trades ~6% device-step speed (scan-carried
+    # weights lose some XLA layout freedom: 33.4 vs 35.4 steps/s probed)
+    # for 4x fewer dispatches over the tunneled device transport — measured
+    # 0.167 vs 0.269 min/epoch on a slow-tunnel day, a wash (~0.16-0.17)
+    # on fast days.  --fuse_steps 1 restores per-step dispatch.
     # Recipe (scripts/sweep_recipe*.py + sweep_sft.py sweeps): 2 fine-tune
     # epochs with linear warmup->decay at 3e-5, trained head restored
     # (init_head), best-of-epoch checkpointing (the reference's own
@@ -91,7 +94,7 @@ def main() -> None:
     # accuracy from the MLM+sft5 pretrain (vs the reference's pretrained
     # 0.57, and 0.5763 under its exact 1-epoch constant-LR protocol).
     args = parse_cli(base=Args(
-        strategy="dp", dtype="bfloat16",
+        strategy="dp", dtype="bfloat16", fuse_steps=4,
         epochs=2, lr_schedule="warmup_linear",
         sft_epochs=5,        # measured best; --sft_epochs 0 = MLM-only warm start
         dev=True, eval_step=50,  # eval in-loop, keep best (reference protocol)

@@ -64,11 +64,12 @@ def init_params(key: jax.Array, cfg: BertConfig) -> Params:
         "v": _dense_init(keys[5], H, H, std, L),
         "o": _dense_init(keys[6], H, H, std, L),
         "attn_ln": _ln_init(H, L),
-        "up": _dense_init(keys[7], H, I, std, L),
-        "down": _dense_init(keys[8], I, H, std, L),
         "mlp_ln": _ln_init(H, L),
     }
-    if cfg.moe_experts:
+    if not cfg.moe_experts:
+        layers["up"] = _dense_init(keys[7], H, I, std, L)
+        layers["down"] = _dense_init(keys[8], I, H, std, L)
+    else:
         # MLP becomes E gated experts: weights gain an expert dim after the
         # layer dim ([L, E, in, out]) so the "ep" sharding mode can split
         # dim 1 over an "expert" mesh axis

@@ -20,6 +20,10 @@ def init_runtime(args) -> Tuple[int, int]:
     Config precedence: explicit ``Args`` fields, then the standard env vars
     (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID`` — the
     MASTER_ADDR/WORLD_SIZE/RANK analog), else single-process.
+
+    Idempotent: entrypoints may call it early (e.g. to resolve a default
+    mesh from the device count) and again inside the shared runner —
+    ``jax.distributed.initialize`` itself raises on a second call.
     """
     import jax
 
@@ -28,7 +32,10 @@ def init_runtime(args) -> Tuple[int, int]:
     nproc = args.num_processes or _int_env("NUM_PROCESSES")
     pid = args.process_id if args.process_id is not None else _int_env("PROCESS_ID")
 
-    if coord and nproc and nproc > 1:
+    if coord and nproc and nproc > 1 and not jax.distributed.is_initialized():
+        # NOTE: checked via jax.distributed, not process_count() — the
+        # latter would initialize the backend, which must not happen before
+        # the distributed client is up
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(nproc),
