@@ -197,12 +197,14 @@ def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
     compress = jnp.bfloat16 if compress_grads else None
     unroll = _unroll(args)
+    smoothing = args.label_smoothing
 
     def local_loss(params, batch, rng):
         logits = bert.classify(params, cfg, batch, dtype=dtype, deterministic=False,
                                rng=rng, remat=remat, attn_impl=attn_impl,
                                unroll=unroll)
-        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"])
+        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"],
+                                    smoothing=smoothing)
         return loss, (correct, batch["example_weight"].sum())
 
     def per_device(state: State, batch) -> Tuple[State, Dict[str, jax.Array]]:

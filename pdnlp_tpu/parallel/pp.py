@@ -213,6 +213,7 @@ def make_pp_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
     from pdnlp_tpu.train.steps import _unroll
 
     unroll = _unroll(args)
+    smoothing = args.label_smoothing
     batch_spec = P(DATA_AXIS) if has_data else P()
 
     def loss_fn(params, batch, rng):
@@ -220,8 +221,8 @@ def make_pp_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
                             n_micro=n_micro, dtype=dtype, deterministic=False,
                             rng=rng, remat=remat, attn_impl=attn_impl,
                             unroll=unroll)
-        loss, correct = weighted_ce(logits, batch["label"],
-                                    batch["example_weight"])
+        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"],
+                                    smoothing=smoothing)
         loss = _select_last(loss, n_stages)
         return loss, _select_last(correct, n_stages)
 

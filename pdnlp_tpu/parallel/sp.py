@@ -52,6 +52,7 @@ def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
     unroll = _unroll(args)
+    smoothing = args.label_smoothing
     if args.attn_dropout > 0:
         raise ValueError(
             "sequence-parallel training has no attention-probability dropout "
@@ -62,7 +63,9 @@ def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
         logits = bert.classify(params, cfg, batch, dtype=dtype,
                                deterministic=False, rng=rng, remat=remat,
                                seq_axis=SEQ, unroll=unroll)
-        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"])
+        loss, correct = weighted_ce(logits, batch["label"],
+                                    batch["example_weight"],
+                                    smoothing=smoothing)
         # gate to seq-shard 0: head grads counted once; encoder grads flow
         # to every shard through the psum backward (see module docstring)
         on0 = (jax.lax.axis_index(SEQ) == 0).astype(loss.dtype)

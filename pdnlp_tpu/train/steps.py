@@ -53,11 +53,16 @@ def init_state(key: jax.Array, cfg: BertConfig, tx: optax.GradientTransformation
     }
 
 
-def weighted_ce(logits: jax.Array, labels: jax.Array, weights: jax.Array
-                ) -> Tuple[jax.Array, jax.Array]:
-    """(weighted mean CE, weighted correct count); filler rows weigh 0."""
+def weighted_ce(logits: jax.Array, labels: jax.Array, weights: jax.Array,
+                smoothing: float = 0.0) -> Tuple[jax.Array, jax.Array]:
+    """(weighted mean CE, weighted correct count); filler rows weigh 0.
+
+    ``smoothing`` > 0 mixes the one-hot target with uniform mass eps/K
+    (label smoothing); 0 reproduces plain CE exactly."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     ce = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    if smoothing:
+        ce = (1.0 - smoothing) * ce + smoothing * (-logp.mean(-1))
     wsum = jnp.maximum(weights.sum(), 1.0)
     loss = (ce * weights).sum() / wsum
     correct = ((jnp.argmax(logits, -1) == labels) * weights).sum()
@@ -81,6 +86,7 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
     remat = bool(args.remat)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
     unroll = _unroll(args)
+    smoothing = args.label_smoothing
 
     def loss_fn(params, batch, rng):
         # aux is the MoE load-balancing loss, a constant 0 for dense models
@@ -91,7 +97,8 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
             params, cfg, batch, dtype=dtype, deterministic=False, rng=rng,
             remat=remat, attn_impl=attn_impl, unroll=unroll, return_aux=True,
         )
-        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"])
+        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"],
+                                    smoothing=smoothing)
         return loss + cfg.moe_aux_coef * aux, (loss, correct)
 
     def train_step(state: State, batch: Dict[str, jax.Array]) -> Tuple[State, Metrics]:

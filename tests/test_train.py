@@ -152,3 +152,25 @@ def test_trainer_end_to_end(cfg, args, capsys):
     res = tr.test(_ListLoader(batches[:2]))
     assert set(res) == {"loss", "accuracy", "y_true", "y_pred"}
     assert len(res["y_true"]) == 16
+
+
+def test_weighted_ce_label_smoothing():
+    """smoothing=0 is exactly plain CE; eps>0 mixes in the uniform term
+    (1-eps)*NLL + eps*mean(-logp), filler rows still weigh 0."""
+    import jax
+    import jax.numpy as jnp
+    from pdnlp_tpu.train.steps import weighted_ce
+
+    logits = jnp.asarray(np.random.RandomState(0).randn(8, 6), jnp.float32)
+    labels = jnp.arange(8) % 6
+    w = jnp.ones((8,)).at[-2:].set(0.0)
+    plain, correct0 = weighted_ce(logits, labels, w)
+    same, _ = weighted_ce(logits, labels, w, smoothing=0.0)
+    assert float(plain) == float(same)
+    eps = 0.1
+    sm, correct1 = weighted_ce(logits, labels, w, smoothing=eps)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    want = ((1 - eps) * nll + eps * (-logp.mean(-1))) * w
+    assert float(sm) == pytest.approx(float(want.sum() / w.sum()), rel=1e-6)
+    assert float(correct0) == float(correct1)  # accuracy ignores smoothing
