@@ -9,10 +9,13 @@ experts for all tokens and the gate-weighted combine contracts the expert
 dim, which XLA turns into the expert all-reduce under the "ep" sharding
 mode).  A Switch-style load-balancing aux loss keeps experts from
 collapsing; the reported loss stays bare CE so dense and MoE runs read on
-the same scale.  Trains from scratch (the in-repo pretrain artifact is a
-dense trunk; its MLP shapes cannot warm-start expert stacks).
+the same scale.  ``--init_from`` with the DENSE pretrain artifact
+*upcycles* it (``train/pretrain.upcycle_layers``): every expert warm-starts
+as a copy of the pretrained dense MLP plus seeded symmetry-breaking noise,
+the gate stays fresh — the standard dense->MoE warm start.
 
     python multi-tpu-moe-cls.py --mesh_shape '{"data": 2, "expert": 4}'
+    python multi-tpu-moe-cls.py --init_from output/pretrained.msgpack --init_head true
 """
 from pdnlp_tpu.train.run import run_parallel
 from pdnlp_tpu.utils.config import Args, parse_cli

@@ -15,49 +15,16 @@ On a 12-layer BERT the natural degrees are S ∈ {2, 3, 4, 6, 12}.  A
 ``data`` mesh axis composes: each data shard runs its own pipeline and
 gradients weight-combine across shards (dp x pp).
 
+The assembly lives in ``pdnlp_tpu/train/run.py`` (``build_pipeline_trainer``)
+so the spawn launcher can execute the same path across real process
+boundaries (``multi-tpu-spawn-cls.py --mode pp``); this entrypoint is the
+single-command flavor.
+
     python multi-tpu-pp-cls.py --mesh_shape '{"stage": 4}' --microbatches 8
     python multi-tpu-pp-cls.py --mesh_shape '{"data": 2, "stage": 4}'
 """
-import jax
-
-from pdnlp_tpu.data.corpus import LABELS
-from pdnlp_tpu.parallel import init_runtime, make_mesh
-from pdnlp_tpu.parallel.pp import (
-    STAGE, make_pp_batch, make_pp_eval_step, make_pp_train_step, setup_pp_model,
-)
-from pdnlp_tpu.train.setup import setup_data
-from pdnlp_tpu.train.trainer import Trainer
+from pdnlp_tpu.train.run import run_pipeline
 from pdnlp_tpu.utils.config import Args, parse_cli
-from pdnlp_tpu.utils.logging import rank0_print
-from pdnlp_tpu.utils.metrics import classification_report
-
-
-def main(args: Args) -> float:
-    init_runtime(args)
-    shape = args.mesh_shape or {STAGE: len(jax.devices())}
-    mesh = make_mesh(num_devices=args.num_devices, shape=shape)
-    # dp x pp composition: a "data" axis scales the global batch the same
-    # way the pure-DP strategies do (DistributedSampler step math)
-    train_loader, dev_loader, tok = setup_data(
-        args, device_batch_mult=mesh.shape.get("data", 1))
-    cfg, tx, state, _ = setup_pp_model(
-        args, tok.vocab_size, mesh,
-        total_steps=len(train_loader) * args.epochs)
-    train_step = make_pp_train_step(cfg, tx, args, mesh,
-                                    n_micro=args.microbatches)
-    eval_step = make_pp_eval_step(cfg, args, mesh, n_micro=args.microbatches)
-    trainer = Trainer(args, cfg, state, train_step, eval_step,
-                      put=make_pp_batch(mesh))
-    rank0_print(f"mesh: {dict(mesh.shape)}  stages: {mesh.shape[STAGE]} x "
-                f"{cfg.num_layers // mesh.shape[STAGE]} layers  "
-                f"microbatches: {args.microbatches}  "
-                f"steps/epoch: {len(train_loader)}")
-    minutes = trainer.train(train_loader, dev_loader)
-    result = trainer.test(dev_loader)
-    rank0_print(f"test loss：{result['loss']:.6f} accuracy：{result['accuracy']:.4f}")
-    rank0_print(classification_report(result["y_true"], result["y_pred"], LABELS))
-    return minutes
-
 
 if __name__ == "__main__":
-    main(parse_cli(base=Args(strategy="pp")))
+    run_pipeline(parse_cli(base=Args(strategy="pp")))

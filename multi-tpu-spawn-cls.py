@@ -23,7 +23,10 @@ import sys
 from pdnlp_tpu.train.run import run_parallel
 from pdnlp_tpu.utils.config import Args, parse_cli
 
-_PORT = 12355  # the tcp://localhost:12345 analog (different port: CI safety)
+# the tcp://localhost:12345 analog (different port: CI safety); the env
+# override lets concurrent/back-to-back gangs avoid a lingering listener
+# from a previously killed gang
+_PORT = int(os.environ.get("PDNLP_SPAWN_PORT", "12355"))
 
 
 def _launch_gang(args, extra_argv) -> list:
@@ -109,7 +112,18 @@ def main() -> int:
     if args.num_processes and args.num_processes > 1 and not already_child \
             and args.process_id is None:
         return spawn(args)
-    run_parallel(args, mode="dp")
+    # --mode picks the sharding the gang executes: dp (default, the
+    # mp.spawn analog), zero (fully-sharded state spanning the process
+    # boundary — the reference's actual DeepSpeed deployment shape,
+    # multi-gpu-deepspeed-cls.py:299-302), tp/ep, or pp (stage axis across
+    # processes).  Cross-process execution of zero and pp is pinned by
+    # tests/test_spawn.py.
+    if args.mode == "pp":
+        from pdnlp_tpu.train.run import run_pipeline
+
+        run_pipeline(args)
+    else:
+        run_parallel(args, mode=args.mode)
     return 0
 
 

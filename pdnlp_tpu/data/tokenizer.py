@@ -13,7 +13,7 @@ truncation="longest_first")`` (``single-gpu-cls.py:52-84``):
 A C++ implementation of the hot path (``csrc/wordpiece.cpp``) is loaded via
 ctypes when built; this module is the reference implementation and the
 fallback, and both must agree bit-for-bit (tested in
-``tests/test_tokenizer.py``).
+``tests/test_native_tokenizer.py``).
 """
 from __future__ import annotations
 

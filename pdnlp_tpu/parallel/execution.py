@@ -203,15 +203,16 @@ def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
         logits = bert.classify(params, cfg, batch, dtype=dtype, deterministic=False,
                                rng=rng, remat=remat, attn_impl=attn_impl,
                                unroll=unroll)
-        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"],
-                                    smoothing=smoothing)
-        return loss, (correct, batch["example_weight"].sum())
+        loss, correct, objective = weighted_ce(
+            logits, batch["label"], batch["example_weight"],
+            smoothing=smoothing)
+        return objective, (loss, correct, batch["example_weight"].sum())
 
     def per_device(state: State, batch) -> Tuple[State, Dict[str, jax.Array]]:
         # distinct dropout stream per shard, common stream per step
         rng = jax.random.fold_in(state["rng"], state["step"])
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-        (loss, (correct, lw)), grads = jax.value_and_grad(
+        (_, (loss, correct, lw)), grads = jax.value_and_grad(
             local_loss, has_aux=True)(state["params"], batch, rng)
         from pdnlp_tpu.parallel.collectives import weighted_shard_scale
 

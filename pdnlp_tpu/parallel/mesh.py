@@ -63,6 +63,37 @@ def make_mesh(
     return Mesh(dev_array, names)
 
 
+def local_data_extent(mesh: Mesh, axis: str = DATA_AXIS):
+    """``(num_shards, shard_id, mult)`` for the data loader: which slice of
+    the global batch THIS process's devices address along ``axis``.
+
+    Generalizes ``local_batch_mult`` to meshes where the data axis may be
+    replicated across processes (e.g. a stage-major ``{"stage": 2, "data":
+    2}`` pipeline mesh: each process holds one stage of EVERY data shard, so
+    every process must feed the full global batch).  ``mult`` scales the
+    per-host batch; ``num_shards``/``shard_id`` select the host's slice of
+    the seeded global permutation."""
+    if axis not in mesh.shape:
+        return 1, 0, 1
+    axis_num = list(mesh.axis_names).index(axis)
+    arr = np.asarray(mesh.devices)
+    pid = jax.process_index()
+    local = {idx[axis_num] for idx in np.ndindex(arr.shape)
+             if arr[idx].process_index == pid}
+    if not local:
+        raise ValueError(f"process {pid} owns no devices of mesh "
+                         f"{dict(mesh.shape)}")
+    mult = len(local)
+    lo, hi = min(local), max(local)
+    if hi - lo + 1 != mult or mesh.shape[axis] % mult:
+        raise ValueError(
+            f"process {pid}'s data-axis indices {sorted(local)} are not a "
+            f"contiguous even slice of the {axis} axis (size "
+            f"{mesh.shape[axis]}) — reorder the mesh axes so each process's "
+            "devices cover a contiguous data-axis block")
+    return mesh.shape[axis] // mult, lo // mult, mult
+
+
 def local_batch_mult(mesh: Mesh, axis: str = DATA_AXIS) -> int:
     """How many data-axis shards this *process* feeds — scales the per-host
     batch so global batch = per-device batch x axis size (the step-count math
@@ -70,6 +101,11 @@ def local_batch_mult(mesh: Mesh, axis: str = DATA_AXIS) -> int:
     Assumes the data axis divides evenly across processes, which holds for
     standard pod topologies (one process per host, hosts x chips = mesh)."""
     nproc = jax.process_count()
+    if axis not in mesh.shape:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)} has no {axis!r} axis — every strategy "
+            f"feeds its batch along one; include it even at size 1, e.g. "
+            f'--mesh_shape \'{{"{axis}": 1, ...}}\'')
     size = mesh.shape[axis]
     if size % nproc:
         raise ValueError(f"data axis {size} not divisible by {nproc} processes")

@@ -19,6 +19,14 @@ scheduler:
   reversed pipeline, with gradients for each stage's layers landing on
   that stage and gradients for the replicated trees ``psum``-combined.
 
+Cost note: embeddings and the pooler/classifier head are replicated, so
+EVERY stage computes the full-batch embedding pass and the head (the
+results are discarded on all but the first/last stage via the masked-psum
+selects).  At BERT scale this is deliberate — embed+head are <2% of layer
+FLOPs and replicating them keeps the tick loop free of extra collectives —
+but it grows linearly with stage count; a deep-pipeline deployment would
+gate them on ``axis_index`` at the price of a divergent program per stage.
+
 Dropout note: per-layer streams key on *global* layer indices
 (``bert.run_layers``), so each layer's stream is stage-placement-invariant;
 the microbatch split makes the batch-level stream differ from the
@@ -221,16 +229,18 @@ def make_pp_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
                             n_micro=n_micro, dtype=dtype, deterministic=False,
                             rng=rng, remat=remat, attn_impl=attn_impl,
                             unroll=unroll)
-        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"],
-                                    smoothing=smoothing)
-        loss = _select_last(loss, n_stages)
-        return loss, _select_last(correct, n_stages)
+        loss, correct, objective = weighted_ce(
+            logits, batch["label"], batch["example_weight"],
+            smoothing=smoothing)
+        # objective (smoothed) is differentiated; bare CE is reported
+        return _select_last(objective, n_stages), (
+            _select_last(loss, n_stages), _select_last(correct, n_stages))
 
     def per_device(state: State, batch):
         rng = jax.random.fold_in(state["rng"], state["step"])
         if has_data:  # distinct dropout stream per data shard (cf. shardmap)
             rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-        (loss, correct), grads = jax.value_and_grad(
+        (_, (loss, correct)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state["params"], batch, rng)
         if has_data:
             # local grads are weighted means over the local shard; combine
@@ -292,7 +302,7 @@ def make_pp_eval_step(cfg: BertConfig, args, mesh: Mesh, n_micro: int = 4):
                             rng=None, remat=False, attn_impl=attn_impl,
                             unroll=unroll)
         w = batch["example_weight"]
-        loss, correct = weighted_ce(logits, batch["label"], w)
+        loss, correct, _ = weighted_ce(logits, batch["label"], w)
         return {
             "loss_sum": data_sum(
                 _select_last(loss * jnp.maximum(w.sum(), 1.0), n_stages)),
@@ -317,12 +327,15 @@ def make_pp_batch(mesh: Mesh):
     """Host batch -> global arrays on the pipeline mesh: split along
     ``data`` when that axis exists (each shard runs its own pipeline),
     replicated across ``stage`` (activations, not data, flow stage to
-    stage)."""
+    stage).  ``make_array_from_process_local_data`` covers both the
+    single-process mesh and a mesh whose axes span processes (each host
+    contributes its data shard / its replica of the full batch)."""
     spec = P(DATA_AXIS) if DATA_AXIS in mesh.shape else P()
     sh = NamedSharding(mesh, spec)
 
     def put(batch):
         return jax.tree_util.tree_map(
-            lambda a: jax.device_put(np.asarray(a), sh), batch)
+            lambda a: jax.make_array_from_process_local_data(
+                sh, np.asarray(a)), batch)
 
     return put

@@ -114,6 +114,14 @@ def state_shardings(state_shapes: Any, mesh: Mesh, mode: str = "dp",
         raise ValueError(
             f"ep needs an {EXPERT_AXIS!r} mesh axis; got {dict(mesh.shape)} "
             '— pass --mesh_shape \'{"data": D, "expert": E}\'')
+    if mode in ("tp", "ep") and axis not in mesh.shape:
+        # the batch still feeds along the data axis; a pure {"model": M}
+        # mesh would die later with a raw KeyError in the batch plumbing
+        raise ValueError(
+            f"{mode} also needs a {axis!r} mesh axis for the batch (size 1 "
+            f"is fine); got {dict(mesh.shape)} — pass --mesh_shape "
+            f'\'{{"{axis}": 1, "{MODEL_AXIS if mode == "tp" else EXPERT_AXIS}'
+            f'": N}}\'')
 
     def _is_float(leaf) -> bool:
         import jax.numpy as jnp

@@ -63,19 +63,21 @@ def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
         logits = bert.classify(params, cfg, batch, dtype=dtype,
                                deterministic=False, rng=rng, remat=remat,
                                seq_axis=SEQ, unroll=unroll)
-        loss, correct = weighted_ce(logits, batch["label"],
-                                    batch["example_weight"],
-                                    smoothing=smoothing)
+        loss, correct, objective = weighted_ce(logits, batch["label"],
+                                               batch["example_weight"],
+                                               smoothing=smoothing)
         # gate to seq-shard 0: head grads counted once; encoder grads flow
-        # to every shard through the psum backward (see module docstring)
+        # to every shard through the psum backward (see module docstring).
+        # objective (smoothed) is differentiated; bare CE is reported.
         on0 = (jax.lax.axis_index(SEQ) == 0).astype(loss.dtype)
-        return loss * on0, (correct * on0, batch["example_weight"].sum() * on0)
+        return objective * on0, (loss * on0, correct * on0,
+                                 batch["example_weight"].sum() * on0)
 
     def per_device(state: State, batch) -> Tuple[State, Dict[str, jax.Array]]:
         rng = jax.random.fold_in(state["rng"], state["step"])
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA))
         rng = jax.random.fold_in(rng, jax.lax.axis_index(SEQ))
-        (loss, (correct, lw)), grads = jax.value_and_grad(
+        (_, (loss, correct, lw)), grads = jax.value_and_grad(
             local_loss, has_aux=True)(state["params"], batch, rng)
         # seq axis: plain sum (loss gated to one shard; each shard owns its
         # slice of encoder grads).  data axis: weight-mass average, exactly
@@ -128,7 +130,7 @@ def make_sp_eval_step(cfg: BertConfig, args, mesh: Mesh):
                                deterministic=True, seq_axis=SEQ,
                                unroll=unroll)
         w = batch["example_weight"]
-        loss, correct = weighted_ce(logits, batch["label"], w)
+        loss, correct, _ = weighted_ce(logits, batch["label"], w)
         wsum = w.sum()
         out = {
             "loss_sum": jax.lax.psum(loss * wsum, DATA),

@@ -318,6 +318,51 @@ def _mlm_artifact(params):
     return {k: v for k, v in params.items() if k not in ("pooler", "classifier")}
 
 
+def upcycle_layers(dense_layers, moe_layers, noise_scale: float = 0.01,
+                   seed: int = 0):
+    """Dense->MoE *sparse upcycling*: build an MoE layer stack whose every
+    expert starts as a copy of the pretrained dense MLP.
+
+    The standard warm start for MoE (Komatsuzaki et al., "Sparse Upcycling"):
+    each expert's up/down kernel ``[L, E, in, out]`` is the dense kernel
+    ``[L, in, out]`` broadcast over the expert dim plus small seeded noise
+    (``noise_scale`` x the kernel's own std) to break expert symmetry; biases
+    copy exactly; the gate keeps its fresh init (there is nothing to upcycle
+    a router from).  All non-MLP trees (attention, LayerNorms) must match
+    shapes exactly and copy through.
+    """
+    rs = np.random.RandomState(seed)
+    out = {}
+    for sub, tmpl in moe_layers.items():
+        if sub == "gate":
+            out[sub] = tmpl  # fresh router
+            continue
+        if sub in ("up", "down"):
+            E = tmpl["kernel"].shape[1]
+            dk = np.asarray(dense_layers[sub]["kernel"])    # [L, in, out]
+            db = np.asarray(dense_layers[sub]["bias"])      # [L, out]
+            if tmpl["kernel"].shape != (dk.shape[0], E) + dk.shape[1:]:
+                raise ValueError(
+                    f"cannot upcycle {sub!r}: dense kernel {dk.shape} does "
+                    f"not broadcast to expert shape {tmpl['kernel'].shape}")
+            kernels = np.broadcast_to(dk[:, None], tmpl["kernel"].shape).copy()
+            kernels += rs.normal(0.0, noise_scale * max(float(dk.std()), 1e-8),
+                                 kernels.shape).astype(kernels.dtype)
+            out[sub] = {"kernel": jnp.asarray(kernels, jnp.float32),
+                        "bias": jnp.asarray(
+                            np.broadcast_to(db[:, None], tmpl["bias"].shape),
+                            jnp.float32)}
+            continue
+        got = jax.tree_util.tree_map(jnp.asarray, dense_layers[sub])
+        t_shapes = jax.tree_util.tree_map(lambda l: l.shape, tmpl)
+        g_shapes = jax.tree_util.tree_map(lambda l: l.shape, got)
+        if t_shapes != g_shapes:
+            raise ValueError(f"cannot upcycle: {sub!r} shapes differ "
+                             f"({g_shapes} vs {t_shapes})")
+        out[sub] = got
+    return out
+
+
 def load_encoder(path: str, params, head: bool = False):
     """Initialize fine-tune params from a pretrain checkpoint: embeddings +
     layers come from the file, pooler/classifier stay at fresh init — the
@@ -325,7 +370,12 @@ def load_encoder(path: str, params, head: bool = False):
 
     ``head=True`` additionally restores pooler + classifier — for checkpoints
     written by the supervised stage (``run_supervised_stage``), whose head was
-    trained on the same 6-class task and is worth keeping."""
+    trained on the same 6-class task and is worth keeping.
+
+    Loading a DENSE checkpoint into an MoE template (``gate`` in the
+    template's layers, none in the file's) upcycles instead of failing:
+    every expert warm-starts as the pretrained dense MLP (+ seeded
+    symmetry-breaking noise), the gate stays fresh (``upcycle_layers``)."""
     import flax.serialization as ser
 
     with open(path, "rb") as f:
@@ -348,6 +398,12 @@ def load_encoder(path: str, params, head: bool = False):
                    "one; MLM checkpoints carry no classifier)" if head else
                    "not a pretrain checkpoint?"))
         tmpl = params[key]
+        if key == "layers" and "gate" in tmpl and "gate" not in restored[key]:
+            out[key] = upcycle_layers(restored[key], tmpl)
+            rank0_print(f"upcycled dense MLPs from {path} into "
+                        f"{tmpl['up']['kernel'].shape[1]} experts "
+                        "(fresh gate, seeded symmetry-breaking noise)")
+            continue
         got = jax.tree_util.tree_map(jnp.asarray, restored[key])
         t_shapes = jax.tree_util.tree_map(lambda l: l.shape, tmpl)
         g_shapes = jax.tree_util.tree_map(lambda l: l.shape, got)

@@ -57,11 +57,21 @@ def build_parallel_trainer(
                          "strategies, not shard_map, fused multi-steps, or "
                          "tp — the staged host<->device transfers are only "
                          "wired into the plain data-axis train step")
-    mult = local_batch_mult(mesh) if scale_batch else 1
+    if scale_batch:
+        # which slice of the global batch this process feeds — handles both
+        # a data axis split across processes (dp/zero: each host its shard)
+        # and one replicated across them (e.g. tp/ep with the model/expert
+        # axis spanning the process boundary: every host the full batch)
+        from pdnlp_tpu.parallel.mesh import local_data_extent
+
+        num_shards, shard_id, mult = local_data_extent(mesh)
+    else:
+        num_shards, shard_id, mult = (jax.process_count(),
+                                      jax.process_index(), 1)
     train_loader, dev_loader, tok = setup_data(
         args,
-        num_shards=jax.process_count(),
-        shard_id=jax.process_index(),
+        num_shards=num_shards,
+        shard_id=shard_id,
         device_batch_mult=mult,
         train_override=train_override,
     )
@@ -83,7 +93,8 @@ def build_parallel_trainer(
     rank0_print(
         f"mesh: {dict(mesh.shape)}  process {jax.process_index()}/{jax.process_count()}"
         f"  mode: {mode}{' +shard_map' if explicit_collectives else ''}"
-        f"  dtype: {args.dtype}  global batch: {args.train_batch_size * mult * jax.process_count() if scale_batch else args.train_batch_size}"
+        f"  dtype: {args.dtype}  global batch: "
+        f"{args.train_batch_size * mesh.shape.get('data', 1) if scale_batch else args.train_batch_size}"
         f"  steps/epoch: {len(train_loader)}")
     return trainer, train_loader, dev_loader
 
@@ -95,6 +106,64 @@ def run_parallel(args: Args, **strategy) -> float:
     trainer, train_loader, dev_loader = build_parallel_trainer(args, **strategy)
     if args.resume_from and os.path.exists(args.resume_path()):
         # elastic restart path: continue bitwise from the latest snapshot
+        trainer.load_resume(args.resume_path())
+        rank0_print(f"resumed from {args.resume_path()} at step "
+                    f"{int(jax.device_get(trainer.state['step']))}")
+    minutes = trainer.train(train_loader, dev_loader)
+    result = trainer.test(dev_loader)
+    rank0_print(f"test loss：{result['loss']:.6f} accuracy：{result['accuracy']:.4f}")
+    rank0_print(classification_report(result["y_true"], result["y_pred"], LABELS))
+    return minutes
+
+
+def build_pipeline_trainer(args: Args, mesh=None):
+    """(trainer, train_loader, dev_loader) for the pipeline (GPipe) path —
+    the ``pp`` twin of ``build_parallel_trainer``, multi-process aware: on a
+    mesh whose ``stage`` (and optionally ``data``) axes span processes, each
+    process feeds its data shard (or the full batch when there is no data
+    axis — the batch is then replicated, stages exchange activations)."""
+    from pdnlp_tpu.parallel.pp import (
+        STAGE, make_pp_batch, make_pp_eval_step, make_pp_train_step,
+        setup_pp_model,
+    )
+    from pdnlp_tpu.parallel import init_runtime, make_mesh
+    from pdnlp_tpu.parallel.mesh import local_data_extent
+
+    if mesh is None:
+        init_runtime(args)
+        shape = args.mesh_shape or {STAGE: len(jax.devices())}
+        mesh = make_mesh(num_devices=args.num_devices, shape=shape)
+    # which slice of the global batch this process feeds: on a stage-major
+    # multi-process mesh the data axis is replicated across processes and
+    # every host feeds the full batch; on a data-major one each host feeds
+    # its shard (local_data_extent covers both)
+    num_shards, shard_id, mult = local_data_extent(mesh)
+    train_loader, dev_loader, tok = setup_data(
+        args, num_shards=num_shards, shard_id=shard_id,
+        device_batch_mult=mult,
+    )
+    cfg, tx, state, _ = setup_pp_model(
+        args, tok.vocab_size, mesh,
+        total_steps=len(train_loader) * args.epochs)
+    train_step = make_pp_train_step(cfg, tx, args, mesh,
+                                    n_micro=args.microbatches)
+    eval_step = make_pp_eval_step(cfg, args, mesh, n_micro=args.microbatches)
+    trainer = Trainer(args, cfg, state, train_step, eval_step,
+                      put=make_pp_batch(mesh))
+    rank0_print(f"mesh: {dict(mesh.shape)}  process "
+                f"{jax.process_index()}/{jax.process_count()}  stages: "
+                f"{mesh.shape[STAGE]} x {cfg.num_layers // mesh.shape[STAGE]}"
+                f" layers  microbatches: {args.microbatches}  "
+                f"steps/epoch: {len(train_loader)}")
+    return trainer, train_loader, dev_loader
+
+
+def run_pipeline(args: Args) -> float:
+    """Train + test on the pipeline path; returns wall-clock minutes."""
+    import os
+
+    trainer, train_loader, dev_loader = build_pipeline_trainer(args)
+    if args.resume_from and os.path.exists(args.resume_path()):
         trainer.load_resume(args.resume_path())
         rank0_print(f"resumed from {args.resume_path()} at step "
                     f"{int(jax.device_get(trainer.state['step']))}")

@@ -54,19 +54,27 @@ def init_state(key: jax.Array, cfg: BertConfig, tx: optax.GradientTransformation
 
 
 def weighted_ce(logits: jax.Array, labels: jax.Array, weights: jax.Array,
-                smoothing: float = 0.0) -> Tuple[jax.Array, jax.Array]:
-    """(weighted mean CE, weighted correct count); filler rows weigh 0.
+                smoothing: float = 0.0
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(weighted mean CE, weighted correct count, training objective);
+    filler rows weigh 0.
 
-    ``smoothing`` > 0 mixes the one-hot target with uniform mass eps/K
-    (label smoothing); 0 reproduces plain CE exactly."""
+    The first element is always the BARE cross-entropy — the reported
+    metric, so smoothed and unsmoothed runs (and train vs eval lines) read
+    on the same scale, mirroring how the MoE aux loss is kept out of the
+    reported loss.  ``smoothing`` > 0 mixes the one-hot target with uniform
+    mass eps/K (label smoothing) in the third element only; at 0 the
+    objective is the bare CE array itself."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     ce = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
-    if smoothing:
-        ce = (1.0 - smoothing) * ce + smoothing * (-logp.mean(-1))
     wsum = jnp.maximum(weights.sum(), 1.0)
     loss = (ce * weights).sum() / wsum
+    objective = loss
+    if smoothing:
+        uniform = ((-logp.mean(-1)) * weights).sum() / wsum
+        objective = (1.0 - smoothing) * loss + smoothing * uniform
     correct = ((jnp.argmax(logits, -1) == labels) * weights).sum()
-    return loss, correct
+    return loss, correct, objective
 
 
 def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
@@ -97,9 +105,10 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
             params, cfg, batch, dtype=dtype, deterministic=False, rng=rng,
             remat=remat, attn_impl=attn_impl, unroll=unroll, return_aux=True,
         )
-        loss, correct = weighted_ce(logits, batch["label"], batch["example_weight"],
-                                    smoothing=smoothing)
-        return loss + cfg.moe_aux_coef * aux, (loss, correct)
+        loss, correct, objective = weighted_ce(
+            logits, batch["label"], batch["example_weight"],
+            smoothing=smoothing)
+        return objective + cfg.moe_aux_coef * aux, (loss, correct)
 
     def train_step(state: State, batch: Dict[str, jax.Array]) -> Tuple[State, Metrics]:
         rng = jax.random.fold_in(state["rng"], state["step"])
@@ -137,11 +146,14 @@ def build_multi_step(step_fn: Callable) -> Callable:
     Math-identical to K separate calls (same updates, in order; per-step
     metrics come back stacked ``[K]``) — what changes is dispatch: one
     host->device round trip per K steps instead of per step; the TPU twin
-    of CUDA-graph step capture.  Measured caveat on this benchmark's shapes
-    (BERT-base, batch 32, one v5e): K=8 is ~60% *slower* than per-step
-    dispatch — scan-carried weights cost XLA layout/fusion freedom — so the
-    default stays ``fuse_steps=1``; the knob is for genuinely
-    dispatch-bound deployments (tiny models, high-latency links).
+    of CUDA-graph step capture.  Measured trade-off on this benchmark's
+    shapes (BERT-base, batch 32, one v5e): scan-carried weights cost ~6%
+    device-step speed (33.4 vs 35.4 steps/s probed — XLA loses some layout
+    freedom), bought back many times over on high-latency links — K=4
+    pinned the epoch at ~0.167 min on a slow-tunnel day where per-step
+    dispatch took 0.269 min, which is why ``bench.py`` ships
+    ``fuse_steps=4``.  On a local-PCIe host where dispatch is cheap,
+    ``fuse_steps=1`` is marginally faster.
     """
 
     def multi_step(state: State, batches: Dict[str, jax.Array]
@@ -176,7 +188,7 @@ def build_eval_step(cfg: BertConfig, args) -> Callable[..., Metrics]:
                                deterministic=True, attn_impl=attn_impl,
                                unroll=unroll)
         w = batch["example_weight"]
-        loss, correct = weighted_ce(logits, batch["label"], w)
+        loss, correct, _ = weighted_ce(logits, batch["label"], w)
         return {
             "loss_sum": loss * jnp.maximum(w.sum(), 1.0),
             "weight": w.sum(),

@@ -57,6 +57,58 @@ class Trainer:
         self.best_accuracy = 0.0
         self._best_params = None  # device-held copy; written once at end
 
+    # -------------------------------------------------- warmup / probe
+    def warmup_compile(self, train_loader, dev_loader=None) -> None:
+        """AOT-compile the step programs before the timed epoch (the
+        warm-CUDA-context analog; ``bench.py`` does the same inline).
+        Steps without ``.lower`` (the lazily-built shard_map pipelines)
+        compile on their first real call instead — cheap under a warmed
+        persistent ``xla_cache``.  ``dev_loader`` supplies the eval step's
+        real batch shape (dev_batch_size may differ from train's)."""
+        host = next(iter(train_loader), None)
+        if host is None:
+            return
+        batch = self.put(host)
+        if hasattr(self.train_step, "lower"):
+            self.train_step.lower(self.state, batch).compile()
+        if self.multi_step is not None and hasattr(self.multi_step, "lower"):
+            k = getattr(self.args, "fuse_steps", 1)
+            stacked = {key: np.stack([v] * k) for key, v in host.items()}
+            self.multi_step.lower(self.state, self.put_fused(stacked)).compile()
+        if self.eval_step is not None and hasattr(self.eval_step, "lower"):
+            dev_host = (next(iter(dev_loader), None)
+                        if dev_loader is not None else None)
+            dev_batch = self.put(dev_host) if dev_host is not None else batch
+            self.eval_step.lower(self.state["params"], dev_batch).compile()
+
+    def probe_steps_per_sec(self, train_loader, n: int = 30):
+        """Steady-state hot-loop rate: ``n`` re-fed steps on a COPY of the
+        state (``train_step`` donates its argument), fetched once — the
+        controlled per-strategy speed metric, free of loader/eval/transport
+        effects.  Returns None when unsupported (host-offloaded moments:
+        ``jnp.copy`` would silently move them on-device and probe a
+        different program)."""
+        if getattr(self.args, "offload_opt_state", False):
+            return None
+        host = next(iter(train_loader), None)
+        if host is None:
+            return None
+        import jax.numpy as jnp
+
+        batch = self.put(host)
+        state = jax.tree_util.tree_map(jnp.copy, self.state)
+        m = None
+        for _ in range(3):
+            state, m = self.train_step(state, batch)
+        float(jax.device_get(m["loss"]))
+        t0 = time.time()
+        for _ in range(n):
+            state, m = self.train_step(state, batch)
+        float(jax.device_get(m["loss"]))
+        dt = time.time() - t0
+        del state, m
+        return n / dt if dt > 0 else None
+
     def _macro_batches(self, loader, k: int):
         """Yield (batch, n_steps, fused): groups of ``k`` host batches
         stacked on a leading step axis, remainder as single steps."""
@@ -108,6 +160,12 @@ class Trainer:
         fault_step = int(os.environ.get("PDNLP_FAULT_STEP", "0"))
         fault_proc = int(os.environ.get("PDNLP_FAULT_PROC", "0"))
         examples = 0
+        if getattr(args, "warmup_compile", False):
+            self.warmup_compile(train_loader, dev_loader)
+        if getattr(args, "probe_steps", 0):
+            rate = self.probe_steps_per_sec(train_loader, args.probe_steps)
+            if rate is not None:
+                rank0_print(f"probe steps/s：{rate:.2f}")
         start = time.time()
         for epoch in range(1, args.epochs + 1):
             train_loader.set_epoch(epoch - 1)

@@ -71,6 +71,13 @@ class Args:
     dtype: str = "float32"                        # "bfloat16" = the AMP analog
     rng_impl: str = "rbg"                         # dropout PRNG (utils.seeding.train_key)
     strategy: str = "single"                      # single|pmap|dp|shardmap|zero|...
+    mode: str = "dp"                              # spawn launcher sharding mode:
+                                                  # dp|zero|tp|ep (shared runner)
+                                                  # or pp (pipeline runner) —
+                                                  # lets ONE multi-process
+                                                  # launcher execute any
+                                                  # placement, incl. shards
+                                                  # spanning process boundaries
     remat: bool = False                           # activation checkpointing (ZeRO analog)
     offload_opt_state: bool = False               # Adam moments in host RAM
                                                   # (DeepSpeed offload analog;
@@ -95,6 +102,14 @@ class Args:
     prefetch: int = 2                             # host->device pipeline depth
     log_every: int = 1
     profile_dir: Optional[str] = None             # jax.profiler trace output
+    warmup_compile: bool = False                  # AOT-compile steps before
+                                                  # the timed epoch (bench
+                                                  # methodology; the warm-
+                                                  # CUDA-context analog)
+    probe_steps: int = 0                          # N re-fed steps probed
+                                                  # before the epoch; prints
+                                                  # the controlled steps/s
+                                                  # (run_matrix's probe col)
 
     # --- multi-host runtime (NCCL/TCPStore rendezvous analog) ---
     coordinator_address: Optional[str] = None     # e.g. "localhost:12345"

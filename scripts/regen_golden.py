@@ -35,6 +35,10 @@ CONFIG = {"model": "bert-tiny", "max_seq_len": 64, "train_batch_size": 16,
           "mesh": "dp over 8 virtual CPU devices", "steps": 30}
 
 
+MODES_ASSET = os.path.join(os.path.dirname(ASSET), "golden_modes.json")
+MODE_STEPS = 10
+
+
 def main():
     args = Args(model=CONFIG["model"], max_seq_len=CONFIG["max_seq_len"],
                 train_batch_size=CONFIG["train_batch_size"],
@@ -57,5 +61,21 @@ def main():
     print(losses[:5], "...")
 
 
+def regen_modes():
+    """10-step traces for EVERY sharding path (tests/golden_modes.py owns
+    the builders, so the regen and the test can never drift)."""
+    from tests.golden_modes import MODES, trace
+
+    out = {}
+    for mode in MODES:
+        losses = [round(x, 8) for x in trace(mode, MODE_STEPS)]
+        out[mode] = {"steps": MODE_STEPS, "losses": losses}
+        print(f"{mode}: {losses[:3]} ...")
+    with open(MODES_ASSET, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {MODES_ASSET}")
+
+
 if __name__ == "__main__":
     main()
+    regen_modes()

@@ -1,0 +1,86 @@
+"""Shared builders for the per-sharding-mode golden traces.
+
+One place constructs the (trainer, loader) pair for every sharding path —
+``tests/test_golden.py`` replays the stored traces against it and
+``scripts/regen_golden.py`` records them, so the two can never drift.
+
+All modes share one config (bert-tiny, seq 64, batch 16, fp32, threefry
+RNG, dropout ON where the path supports it) on the 8-device CPU mesh; each
+mode differs ONLY in placement, which is the property the traces pin: a
+refactor of any sharding path that changes its math shifts its trace.
+"""
+from pdnlp_tpu.train.run import build_parallel_trainer, build_pipeline_trainer
+from pdnlp_tpu.utils.config import Args
+
+MODES = ("dp", "zero", "shardmap", "tp", "pp", "sp", "ep")
+
+BASE = dict(max_seq_len=64, train_batch_size=16, data_limit=2000,
+            dtype="float32", seed=123, rng_impl="threefry2x32",
+            log_every=10 ** 9)
+
+
+def golden_args(mode: str) -> Args:
+    kw = dict(BASE)
+    if mode == "ep":
+        kw.update(model="bert-tiny-moe", mesh_shape={"data": 4, "expert": 2})
+    else:
+        kw["model"] = "bert-tiny"
+    if mode == "tp":
+        kw["mesh_shape"] = {"data": 4, "model": 2}
+    if mode == "pp":
+        kw.update(mesh_shape={"data": 4, "stage": 2}, microbatches=2)
+    if mode == "sp":
+        # ring attention has no attention-probability dropout (sp entrypoint
+        # requires --attn_dropout 0); hidden-state dropout stays ON
+        kw.update(mesh_shape={"data": 4, "seq": 2}, attn_dropout=0.0)
+    return Args(strategy=f"golden-{mode}", **kw)
+
+
+def build_mode_trainer(mode: str):
+    """(trainer, train_loader) for one sharding mode on the CPU mesh."""
+    args = golden_args(mode)
+    if mode in ("dp", "zero", "ep"):
+        trainer, loader, _ = build_parallel_trainer(args, mode=mode)
+    elif mode == "tp":
+        trainer, loader, _ = build_parallel_trainer(args, mode="tp")
+    elif mode == "shardmap":
+        trainer, loader, _ = build_parallel_trainer(
+            args, mode="dp", explicit_collectives=True)
+    elif mode == "pp":
+        trainer, loader, _ = build_pipeline_trainer(args)
+    elif mode == "sp":
+        from pdnlp_tpu.parallel import local_batch_mult, make_mesh
+        from pdnlp_tpu.parallel.sp import (
+            make_sp_batch, make_sp_eval_step, make_sp_train_step,
+        )
+        from pdnlp_tpu.train.setup import setup_data, setup_model
+        from pdnlp_tpu.train.trainer import Trainer
+
+        mesh = make_mesh(shape=args.mesh_shape)
+        loader, _, tok = setup_data(
+            args, device_batch_mult=local_batch_mult(mesh))
+        cfg, tx, state = setup_model(args, tok.vocab_size)
+        example = next(iter(loader))
+        trainer = Trainer(args, cfg, state,
+                          make_sp_train_step(cfg, tx, args, mesh)(example),
+                          make_sp_eval_step(cfg, args, mesh)(example),
+                          put=make_sp_batch(mesh))
+    else:
+        raise ValueError(f"unknown golden mode {mode!r}")
+    return trainer, loader
+
+
+def trace(mode: str, steps: int):
+    """The first ``steps`` training losses of a fresh seeded run."""
+    trainer, loader = build_mode_trainer(mode)
+    losses, epoch = [], 0
+    while len(losses) < steps:
+        loader.set_epoch(epoch)
+        for b in loader:
+            trainer.state, m = trainer.train_step(trainer.state,
+                                                  trainer.put(b))
+            losses.append(float(m["loss"]))
+            if len(losses) == steps:
+                break
+        epoch += 1
+    return losses

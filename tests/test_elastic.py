@@ -6,7 +6,12 @@ its NCCL peers forever.  Here the spawn launcher is also a failure detector
 periodically; the parent kills and relaunches the whole gang from the newest
 snapshot on a crash or stall.  The acceptance bar is the strongest one the
 framework's bitwise-resume contract allows: a run whose rank is KILLED
-mid-training must end with byte-identical parameters to an undisturbed run.
+mid-training must end with byte-identical (``array_equal``) parameters to an
+undisturbed run of the IDENTICAL 2-process x 4-device layout — same
+programs, same collective reassociation, so exact equality is the honest
+assert.  A cross-layout comparison (8-device single process) is additionally
+pinned to float tolerance, where reassociated reductions legitimately
+differ in the last bits.
 """
 import os
 import re
@@ -100,8 +105,67 @@ def test_elastic_restart_completes(elastic_run):
     assert (out / "spawn-cls.msgpack").exists()
 
 
-def test_elastic_params_match_undisturbed_run(elastic_run, ndev):
-    """Crash + gang restart + bitwise resume == a run with no failure."""
+@pytest.fixture(scope="module")
+def undisturbed_run(tmp_path_factory):
+    """The SAME 2-proc x 4-device spawn configuration with no chaos hook —
+    the layout-matched control for the byte-identical assert."""
+    out = tmp_path_factory.mktemp("undisturbed")
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        # own rendezvous port: the elastic fixture's killed gang may leave
+        # a worker lingering on the default one
+        PDNLP_SPAWN_PORT="12391",
+    )
+    for k in ("COORDINATOR_ADDRESS", "PROCESS_ID",
+              "PDNLP_FAULT_STEP", "PDNLP_FAULT_PROC"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "multi-tpu-spawn-cls.py"),
+         "--num_processes", "2", "--output_dir", str(out), *COMMON_ARGS],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    return proc, out
+
+
+def _flat_raw(path):
+    """(structure, concatenated leaves) of a raw msgpack checkpoint — no
+    model template needed for an exact-bytes comparison."""
+    import flax.serialization as ser
+    import jax
+
+    with open(str(path), "rb") as f:
+        tree = ser.msgpack_restore(f.read())
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return treedef, np.concatenate([np.ravel(l) for l in leaves])
+
+
+def test_elastic_params_byte_identical_to_undisturbed_run(
+        elastic_run, undisturbed_run):
+    """Crash + gang restart + bitwise resume == a run with no failure,
+    byte for byte: both runs use the identical 2x4 spawn layout, so the
+    programs (and their collective reassociation) are the same and
+    ``array_equal`` is the justified assert."""
+    proc, out = elastic_run
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-3000:])
+    uproc, uout = undisturbed_run
+    assert uproc.returncode == 0, (uproc.stdout[-2000:], uproc.stderr[-3000:])
+
+    def_e, flat_elastic = _flat_raw(out / "spawn-cls.msgpack")
+    def_c, flat_clean = _flat_raw(uout / "spawn-cls.msgpack")
+    assert def_e == def_c
+    assert np.array_equal(flat_elastic, flat_clean), (
+        f"{(flat_elastic != flat_clean).sum()} of {flat_elastic.size} leaves"
+        f" differ; max abs diff {np.abs(flat_elastic - flat_clean).max()}")
+
+
+def test_elastic_params_match_single_process_run(elastic_run, ndev):
+    """Cross-LAYOUT parity (2x4 spawn vs 8-device in-process): collective
+    reassociation differs between layouts, so this is a float-tolerance
+    check, not the byte-identical contract (which
+    ``test_elastic_params_byte_identical_to_undisturbed_run`` pins against
+    the layout-matched control)."""
     proc, out = elastic_run
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-3000:])
 

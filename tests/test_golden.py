@@ -12,11 +12,14 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from pdnlp_tpu.train.run import build_parallel_trainer
 from pdnlp_tpu.utils.config import Args
 
 ASSET = os.path.join(os.path.dirname(__file__), "assets", "golden_trace.json")
+MODES_ASSET = os.path.join(os.path.dirname(__file__), "assets",
+                           "golden_modes.json")
 
 
 def test_golden_loss_trace(ndev):
@@ -40,3 +43,25 @@ def test_golden_loss_trace(ndev):
                 break
         epoch += 1
     np.testing.assert_allclose(losses, golden["losses"], rtol=1e-5, atol=1e-6)
+
+
+def _modes_golden():
+    with open(MODES_ASSET) as f:
+        return json.load(f)
+
+
+from tests.golden_modes import MODES
+
+
+@pytest.mark.parametrize("mode", list(MODES))
+def test_golden_mode_traces(mode, ndev):
+    """10-step loss trace per SHARDING PATH (zero/tp/pp/sp/ep/shardmap next
+    to dp): a refactor of any path that silently changes its math shifts its
+    trace.  Same contract as the 30-step dp golden; regenerate with
+    scripts/regen_golden.py only for deliberate training-math changes."""
+    assert ndev == 8, "traces were recorded on the 8-device CPU mesh"
+    from tests.golden_modes import trace
+
+    golden = _modes_golden()[mode]
+    got = trace(mode, golden["steps"])
+    np.testing.assert_allclose(got, golden["losses"], rtol=1e-5, atol=1e-6)

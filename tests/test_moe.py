@@ -103,7 +103,7 @@ def test_moe_trains_and_reports_bare_ce(ndev):
     # deterministic forward == train forward); the metric must match it,
     # NOT the CE + moe_aux_coef * aux objective
     logits, aux = bert.classify(params0, cfg, b, return_aux=True)
-    bare, _ = weighted_ce(logits, b["label"], b["example_weight"])
+    bare, _, _ = weighted_ce(logits, b["label"], b["example_weight"])
     assert losses[0] == pytest.approx(float(bare), rel=1e-5)
     assert abs(losses[0] - float(bare + cfg.moe_aux_coef * aux)) > 1e-4
 
@@ -161,3 +161,47 @@ def test_ep_and_moe_guards(ndev):
         make_shardmap_train_step(cfg, tx, args, make_mesh(shape={"data": ndev}))
     with pytest.raises(ValueError, match="MoE"):
         setup_pp_model(args, VOCAB, make_mesh(shape={"stage": 2}))
+
+
+def test_upcycle_dense_checkpoint_into_moe(tmp_path):
+    """Sparse upcycling: a DENSE pretrain checkpoint loads into an MoE
+    template — every expert starts as the dense MLP (+ tiny seeded noise),
+    the gate stays fresh, and the non-MLP trees copy bit-exactly."""
+    from pdnlp_tpu.train import checkpoint as ckpt
+    from pdnlp_tpu.train.pretrain import load_encoder
+
+    dense_cfg = get_config("bert-tiny", vocab_size=VOCAB, num_labels=6)
+    dense = bert.init_params(jax.random.PRNGKey(7), dense_cfg)
+    path = str(tmp_path / "dense.msgpack")
+    ckpt.save(path, dense)
+
+    moe_cfg = get_config("bert-tiny-moe", vocab_size=VOCAB, num_labels=6)
+    moe = bert.init_params(jax.random.PRNGKey(8), moe_cfg)
+    got = load_encoder(path, moe, head=True)
+
+    E = moe_cfg.moe_experts
+    up = np.asarray(got["layers"]["up"]["kernel"])       # [L, E, H, I]
+    dk = np.asarray(dense["layers"]["up"]["kernel"])     # [L, H, I]
+    for e in range(E):
+        diff = np.abs(up[:, e] - dk)
+        assert diff.max() < 0.1 * np.abs(dk).std() + 1e-3  # close to dense
+    # experts differ from EACH OTHER (symmetry broken)
+    assert np.abs(up[:, 0] - up[:, 1]).max() > 0
+    # biases copy exactly; gate is the fresh template init
+    np.testing.assert_array_equal(
+        np.asarray(got["layers"]["up"]["bias"][:, 0]),
+        np.asarray(dense["layers"]["up"]["bias"]))
+    np.testing.assert_array_equal(np.asarray(got["layers"]["gate"]["kernel"]),
+                                  np.asarray(moe["layers"]["gate"]["kernel"]))
+    # attention + LN trees copy bit-exactly; head restored under head=True
+    np.testing.assert_array_equal(np.asarray(got["layers"]["q"]["kernel"]),
+                                  np.asarray(dense["layers"]["q"]["kernel"]))
+    np.testing.assert_array_equal(np.asarray(got["pooler"]["kernel"]),
+                                  np.asarray(dense["pooler"]["kernel"]))
+    # upcycled forward stays close to the dense forward (same function at
+    # noise->0: every expert == the dense MLP and gating is convex)
+    b = fake_batch(4)
+    dense_logits = bert.classify(dense, dense_cfg, b)
+    moe_logits = bert.classify(got, moe_cfg, b)
+    np.testing.assert_allclose(np.asarray(moe_logits),
+                               np.asarray(dense_logits), atol=0.35)

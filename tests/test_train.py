@@ -155,8 +155,10 @@ def test_trainer_end_to_end(cfg, args, capsys):
 
 
 def test_weighted_ce_label_smoothing():
-    """smoothing=0 is exactly plain CE; eps>0 mixes in the uniform term
-    (1-eps)*NLL + eps*mean(-logp), filler rows still weigh 0."""
+    """The reported loss is ALWAYS the bare CE (train/dev lines stay
+    comparable, mirroring the moe_aux_coef convention); the smoothed
+    objective (1-eps)*NLL + eps*mean(-logp) is returned separately and
+    equals the bare CE at eps=0.  Filler rows weigh 0 in both."""
     import jax
     import jax.numpy as jnp
     from pdnlp_tpu.train.steps import weighted_ce
@@ -164,11 +166,12 @@ def test_weighted_ce_label_smoothing():
     logits = jnp.asarray(np.random.RandomState(0).randn(8, 6), jnp.float32)
     labels = jnp.arange(8) % 6
     w = jnp.ones((8,)).at[-2:].set(0.0)
-    plain, correct0 = weighted_ce(logits, labels, w)
-    same, _ = weighted_ce(logits, labels, w, smoothing=0.0)
-    assert float(plain) == float(same)
+    plain, correct0, obj0 = weighted_ce(logits, labels, w)
+    same, _, _ = weighted_ce(logits, labels, w, smoothing=0.0)
+    assert float(plain) == float(same) == float(obj0)
     eps = 0.1
-    sm, correct1 = weighted_ce(logits, labels, w, smoothing=eps)
+    bare, correct1, sm = weighted_ce(logits, labels, w, smoothing=eps)
+    assert float(bare) == float(plain)  # reported metric ignores smoothing
     logp = jax.nn.log_softmax(logits)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
     want = ((1 - eps) * nll + eps * (-logp.mean(-1))) * w
