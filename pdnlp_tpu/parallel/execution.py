@@ -187,11 +187,6 @@ def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
     """
     from pdnlp_tpu.train.steps import _unroll
 
-    if cfg.moe_experts:
-        raise ValueError("MoE models run on the jit strategies (dp/zero/ep)"
-                         " — the shard_map path's local loss has no aux-"
-                         "loss plumbing and would silently skip load "
-                         "balancing")
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
@@ -200,13 +195,21 @@ def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
     smoothing = args.label_smoothing
 
     def local_loss(params, batch, rng):
-        logits = bert.classify(params, cfg, batch, dtype=dtype, deterministic=False,
-                               rng=rng, remat=remat, attn_impl=attn_impl,
-                               unroll=unroll)
+        # MoE aux (0 for dense): computed over the LOCAL shard's batch and
+        # weight-averaged across shards with the loss below — a per-shard
+        # estimator of the balancing statistics, vs the jit paths' global-
+        # batch one (the standard per-device formulation; both pressure the
+        # router identically in expectation).  It joins the optimized
+        # objective only — the reported loss stays bare CE.
+        logits, aux = bert.classify(params, cfg, batch, dtype=dtype,
+                                    deterministic=False, rng=rng, remat=remat,
+                                    attn_impl=attn_impl, unroll=unroll,
+                                    return_aux=True)
         loss, correct, objective = weighted_ce(
             logits, batch["label"], batch["example_weight"],
             smoothing=smoothing)
-        return objective, (loss, correct, batch["example_weight"].sum())
+        return objective + cfg.moe_aux_coef * aux, (
+            loss, correct, batch["example_weight"].sum())
 
     def per_device(state: State, batch) -> Tuple[State, Dict[str, jax.Array]]:
         # distinct dropout stream per shard, common stream per step

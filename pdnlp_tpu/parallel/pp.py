@@ -90,10 +90,10 @@ def setup_pp_model(args, vocab_size: int, mesh: Mesh, total_steps: int = None
         raise ValueError(f"pipeline degree {n_stages} must divide num_layers "
                          f"({cfg.num_layers}) — stages hold contiguous "
                          "layer slices")
-    if cfg.moe_experts:
-        raise ValueError("pp does not support MoE models yet — the pipeline "
-                         "loop has no aux-loss plumbing and would silently "
-                         "skip load balancing")
+    # MoE composes with pp: expert stacks [L, E, in, out] split their
+    # leading layer dim like every other layer weight, and the tick loop
+    # accumulates each stage's share of the load-balancing aux (gated to
+    # real ticks; psum'd over stages in the train step)
     root = set_seed(args.seed)
     init_key, _ = jax.random.split(root)
     train_rng = train_key(args.seed, getattr(args, "rng_impl", "rbg"))
@@ -118,10 +118,12 @@ def setup_pp_model(args, vocab_size: int, mesh: Mesh, total_steps: int = None
 
 def _pp_logits(params, batch, cfg, *, n_stages: int, n_micro: int, dtype,
                deterministic: bool, rng, remat: bool, attn_impl: str,
-               unroll) -> jax.Array:
-    """The pipelined forward, INSIDE ``shard_map``: returns logits
-    [B, num_labels] that are only meaningful on the LAST stage (callers
-    ``psum``-select).  ``params['layers']`` leaves arrive with leading dim
+               unroll):
+    """The pipelined forward, INSIDE ``shard_map``: returns ``(logits,
+    aux)`` where logits [B, num_labels] are only meaningful on the LAST
+    stage (callers ``psum``-select) and ``aux`` is this STAGE's share of
+    the MoE load-balancing loss (0 for dense models; callers ``psum`` over
+    ``stage``).  ``params['layers']`` leaves arrive with leading dim
     ``L/S`` (this stage's slice)."""
     s = jax.lax.axis_index(STAGE)
     B = batch["label"].shape[0]
@@ -143,7 +145,7 @@ def _pp_logits(params, batch, cfg, *, n_stages: int, n_micro: int, dtype,
     masks = batch["attention_mask"].reshape(n_micro, b, seq)
 
     def tick(carry, t):
-        h_in, outs = carry
+        h_in, outs, aux_sum = carry
         # stage 0 ingests microbatch t; this stage holds microbatch t - s
         # (both clipped during fill/drain bubble ticks)
         t_in = jnp.clip(t, 0, n_micro - 1)
@@ -151,12 +153,18 @@ def _pp_logits(params, batch, cfg, *, n_stages: int, n_micro: int, dtype,
         x = jnp.where(s == 0, x0, h_in)
         m_here = jnp.clip(t - s, 0, n_micro - 1)
         mask = jax.lax.dynamic_index_in_dim(masks, m_here, 0, keepdims=False)
-        x = bert.run_layers(
+        x, aux = bert.run_layers(
             local_layers, cfg, x, li=s * lk + jnp.arange(lk),
             bias=bert.mask_bias(mask, dtype), dtype=dtype,
             deterministic=deterministic,
             rng=jax.random.fold_in(rng, m_here), remat=remat,
-            attn_impl=attn_impl, unroll=unroll)
+            attn_impl=attn_impl, unroll=unroll, with_aux=True,
+            token_mask=mask)
+        # bubble ticks recompute a clipped microbatch whose result is
+        # discarded — its aux must not count (it would double-weight the
+        # edge microbatches); a real tick on this stage is 0 <= t-s < M
+        real = ((t - s >= 0) & (t - s < n_micro)).astype(aux.dtype)
+        aux_sum = aux_sum + aux * real
         # the last stage finishes microbatch t - (S-1) this tick; only its
         # [CLS] row feeds the head, so that is all the loop accumulates
         done = t - (n_stages - 1)
@@ -167,16 +175,22 @@ def _pp_logits(params, batch, cfg, *, n_stages: int, n_micro: int, dtype,
             outs, jnp.where(write, x[:, 0, :], cur), d_idx, 0)
         h_out = jax.lax.ppermute(
             x, STAGE, [(i, (i + 1) % n_stages) for i in range(n_stages)])
-        return (h_out, outs), None
+        return (h_out, outs, aux_sum), None
 
     h0 = jnp.zeros((b, seq, cfg.hidden_size), dtype)
     outs0 = jnp.zeros((n_micro, b, cfg.hidden_size), dtype)
-    (_, outs), _ = jax.lax.scan(
-        tick, (h0, outs0), jnp.arange(n_micro + n_stages - 1))
+    (_, outs, aux_sum), _ = jax.lax.scan(
+        tick, (h0, outs0, jnp.zeros((), jnp.float32)),
+        jnp.arange(n_micro + n_stages - 1))
 
-    return bert.pooled_logits(
+    logits = bert.pooled_logits(
         params, cfg, outs.reshape(B, cfg.hidden_size), dtype=dtype,
         drop_rng=None if deterministic else jax.random.fold_in(rng, 10_000))
+    # mean over microbatches: each real tick added this stage's layer-slice
+    # aux for one microbatch, so the per-microbatch mean matches the dense-
+    # dispatch convention (sum over layers of batch-statistic aux) up to
+    # the estimator (per-microbatch vs full-batch statistics)
+    return logits, aux_sum / n_micro
 
 
 def _select_last(x, n_stages: int):
@@ -225,15 +239,18 @@ def make_pp_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
     batch_spec = P(DATA_AXIS) if has_data else P()
 
     def loss_fn(params, batch, rng):
-        logits = _pp_logits(params, batch, cfg, n_stages=n_stages,
-                            n_micro=n_micro, dtype=dtype, deterministic=False,
-                            rng=rng, remat=remat, attn_impl=attn_impl,
-                            unroll=unroll)
+        logits, aux = _pp_logits(params, batch, cfg, n_stages=n_stages,
+                                 n_micro=n_micro, dtype=dtype,
+                                 deterministic=False, rng=rng, remat=remat,
+                                 attn_impl=attn_impl, unroll=unroll)
         loss, correct, objective = weighted_ce(
             logits, batch["label"], batch["example_weight"],
             smoothing=smoothing)
-        # objective (smoothed) is differentiated; bare CE is reported
-        return _select_last(objective, n_stages), (
+        # objective (smoothed + MoE aux, each stage contributing its layer
+        # slice's share) is differentiated; bare CE is reported
+        objective = (_select_last(objective, n_stages)
+                     + cfg.moe_aux_coef * jax.lax.psum(aux, STAGE))
+        return objective, (
             _select_last(loss, n_stages), _select_last(correct, n_stages))
 
     def per_device(state: State, batch):
@@ -297,10 +314,10 @@ def make_pp_eval_step(cfg: BertConfig, args, mesh: Mesh, n_micro: int = 4):
         return jax.lax.psum(x, DATA_AXIS) if has_data else x
 
     def per_device(params, batch):
-        logits = _pp_logits(params, batch, cfg, n_stages=n_stages,
-                            n_micro=n_micro, dtype=dtype, deterministic=True,
-                            rng=None, remat=False, attn_impl=attn_impl,
-                            unroll=unroll)
+        logits, _ = _pp_logits(params, batch, cfg, n_stages=n_stages,
+                               n_micro=n_micro, dtype=dtype,
+                               deterministic=True, rng=None, remat=False,
+                               attn_impl=attn_impl, unroll=unroll)
         w = batch["example_weight"]
         loss, correct, _ = weighted_ce(logits, batch["label"], w)
         return {

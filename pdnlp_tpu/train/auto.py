@@ -49,6 +49,13 @@ class TrainerArgs:
     load_best_model_at_end: bool = True
     metric_for_best_model: str = "accuracy"
     greater_is_better: bool = True
+    # K optimizer steps fused into one device dispatch (lax.scan —
+    # math-identical, per-step losses come back stacked), the same
+    # fuse_steps knob the other strategies expose.  Must divide
+    # logging/eval/save steps so every cadence boundary falls on a fused-
+    # group boundary.  The big win is on high-RTT device transports where
+    # per-step dispatch dominates the epoch.
+    fuse_steps: int = 1
     # Rotation checkpoints are cast to this dtype ON DEVICE before the
     # fetch: "bfloat16" halves both the device->host bytes (the dominant
     # cost over a tunneled transport at save_steps=50: 8 full-precision
@@ -88,6 +95,7 @@ class TrainerArgs:
             max_seq_len=self.max_seq_len,
             init_from=self.init_from,
             init_head=self.init_head,
+            fuse_steps=self.fuse_steps,
         )
 
 
@@ -125,6 +133,13 @@ class AutoTrainer:
                  compute_metrics: Callable[..., Dict[str, float]] = None):
         from pdnlp_tpu.train.run import build_parallel_trainer
 
+        if targs.fuse_steps > 1:
+            for name in ("logging_steps", "eval_steps", "save_steps"):
+                if getattr(targs, name) % targs.fuse_steps:
+                    raise ValueError(
+                        f"fuse_steps={targs.fuse_steps} must divide {name}="
+                        f"{getattr(targs, name)} — cadence boundaries must "
+                        "fall on fused-group boundaries")
         self.targs = targs
         self.args = targs.to_args()
         self.compute_metrics = compute_metrics or default_compute_metrics
@@ -149,20 +164,33 @@ class AutoTrainer:
         t.warmup_compile(self.train_loader, self.dev_loader)
         start = time.time()
         metrics = None
+        last_loss = None
         for epoch in range(1, targs.num_train_epochs + 1):
             self.train_loader.set_epoch(epoch - 1)
-            for batch in self.train_loader:
-                t.state, metrics = t.train_step(t.state, t.put(batch))
-                gstep += 1
-                if gstep % targs.logging_steps == 0:
+            # fused groups ride one device dispatch per K steps (the other
+            # strategies' fuse_steps, Trainer._macro_batches does the
+            # stacking); cadence checks below fire once per group, which
+            # the divisibility guard in __init__ makes exact
+            for batch, n, fused in t._macro_batches(self.train_loader,
+                                                    targs.fuse_steps):
+                if fused:
+                    t.state, metrics = t.multi_step(t.state,
+                                                    t.put_fused(batch))
+                    last_loss = metrics["loss"][-1]
+                else:
+                    t.state, metrics = t.train_step(t.state, t.put(batch))
+                    last_loss = metrics["loss"]
+                prev = gstep
+                gstep += n
+                if gstep // targs.logging_steps != prev // targs.logging_steps:
                     rank0_print(f"step {gstep}/{total} "
-                                f"loss {float(metrics['loss']):.4f}")
-                if gstep % targs.eval_steps == 0:
+                                f"loss {float(last_loss):.4f}")
+                if gstep // targs.eval_steps != prev // targs.eval_steps:
                     self._eval_and_log(gstep)
-                if gstep % targs.save_steps == 0:
+                if gstep // targs.save_steps != prev // targs.save_steps:
                     self._save_checkpoint(gstep)
-        if metrics is not None:
-            float(jax.device_get(metrics["loss"]))  # completion barrier
+        if last_loss is not None:
+            float(jax.device_get(last_loss))  # completion barrier
         self._drain_writers()   # all checkpoint files durable before reload
         self._rotate()
         runtime = time.time() - start

@@ -73,7 +73,10 @@ RUNS = [
     ("trainer (HF Trainer analog)", [sys.executable, "multi-tpu-trainer-cls.py",
                                      "--bf16", "true", *PRETRAIN],
      {}, None,
-     "save/eval every 50 steps, bf16 rotation saves, best-model reload"),
+     "save/eval every 50 steps, bf16 rotation saves, best-model reload; "
+     "row is save-transport-bound: 6 x 205MB fetches at the tunnel's "
+     "measured ~8MB/s floor the epoch at ~2.6 min regardless of step "
+     "speed (fusion changes nothing — see README)"),
     ("sp (ring attention, seq 512)", [sys.executable, "multi-tpu-sp-cls.py",
                                       "--max_seq_len", "512",
                                       "--train_batch_size", "8",

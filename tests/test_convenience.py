@@ -103,3 +103,28 @@ def test_sweep_discovers_and_validates_checkpoints(tmp_path):
                   {"a": np.ones((4, 5), np.float32)})
     back = ckpt.load(str(tmp_path / "x-cls.msgpack"), good)
     np.testing.assert_array_equal(back["a"], good["a"])
+
+
+def test_autotrainer_fused_steps(corpus_path, tmp_path):
+    """fuse_steps>1: K steps ride one dispatch (lax.scan), cadence
+    boundaries stay exact, and the run matches the unfused one's eval
+    metric (math-identical scan).  Also pins the divisibility guard."""
+    common = dict(
+        model="bert-tiny", data_path=corpus_path, data_limit=400,
+        max_seq_len=16, eval_steps=2, save_steps=2, save_total_limit=2,
+        logging_steps=10 ** 6, num_train_epochs=1,
+    )
+    fused = AutoTrainer(TrainerArgs(
+        output_dir=str(tmp_path / "fused"), fuse_steps=2, **common))
+    fm = fused.train()
+    fe = fused.evaluate()
+    plain = AutoTrainer(TrainerArgs(
+        output_dir=str(tmp_path / "plain"), **common))
+    pm = plain.train()
+    pe = plain.evaluate()
+    assert fm["global_step"] == pm["global_step"]
+    assert fe["eval_loss"] == pytest.approx(pe["eval_loss"], rel=1e-5)
+    assert fused.best_ckpt is not None and os.path.isdir(fused.best_ckpt)
+    with pytest.raises(ValueError, match="must divide"):
+        AutoTrainer(TrainerArgs(output_dir=str(tmp_path / "bad"),
+                                fuse_steps=3, **common))
