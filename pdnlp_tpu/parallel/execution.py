@@ -83,7 +83,8 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
 
     def init_fn(key, rng):
         params = bert.init_params(key, cfg)
-        return init_state(key, cfg, tx, rng=rng, params=params)
+        return init_state(key, cfg, tx, rng=rng, params=params,
+                          ema=getattr(args, "ema_decay", 0.0) > 0)
 
     state_shapes = jax.eval_shape(init_fn, init_key, train_rng)
     shardings = state_shardings(state_shapes, mesh, mode)
@@ -112,6 +113,14 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
         params = load_encoder(args.init_from, state["params"],
                               head=getattr(args, "init_head", False))
         state["params"] = jax.device_put(params, shardings["params"])
+        if "ema" in state:  # the EMA tracks the WARM-STARTED weights
+            state["ema"] = jax.device_put(params, shardings["ema"])
+    if "ema" in state:
+        # force DISTINCT buffers: the init jit (and device_put's cache) may
+        # alias the identical params/ema values to one buffer — the first
+        # donated train step would then invalidate both references
+        # (observed as "TPU backend error (InvalidArgument)" at eval fetch)
+        state["ema"] = jax.tree_util.tree_map(jnp.copy, state["ema"])
     return cfg, tx, state, shardings
 
 
@@ -187,6 +196,11 @@ def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
     """
     from pdnlp_tpu.train.steps import _unroll
 
+    if getattr(args, "ema_decay", 0.0) > 0:
+        raise ValueError("--ema_decay runs on the jit strategies (dp/zero/"
+                         "tp/ep) — the shard_map step does not maintain the "
+                         "EMA tree and would silently evaluate stale "
+                         "weights")
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
     attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"

@@ -83,6 +83,10 @@ def setup_pp_model(args, vocab_size: int, mesh: Mesh, total_steps: int = None
         raise ValueError(
             f"pp needs a {STAGE!r} mesh axis; got {dict(mesh.shape)} — "
             'pass --mesh_shape \'{"stage": S}\'')
+    if getattr(args, "ema_decay", 0.0) > 0:
+        raise ValueError("--ema_decay runs on the jit strategies (dp/zero/"
+                         "tp/ep) — the pipeline step does not maintain the "
+                         "EMA tree")
     n_stages = mesh.shape[STAGE]
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
                      dropout=args.dropout, attn_dropout=args.attn_dropout)

@@ -58,6 +58,10 @@ def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
             "sequence-parallel training has no attention-probability dropout "
             "(ops.ring does not implement it); pass --attn_dropout 0 "
             "explicitly so runs stay comparable across strategies")
+    if getattr(args, "ema_decay", 0.0) > 0:
+        raise ValueError("--ema_decay runs on the jit strategies (dp/zero/"
+                         "tp/ep) — the sequence-parallel step does not "
+                         "maintain the EMA tree")
 
     def local_loss(params, batch, rng):
         logits = bert.classify(params, cfg, batch, dtype=dtype,

@@ -87,5 +87,6 @@ def setup_model(args, vocab_size: int, total_steps: int = None):
                               head=getattr(args, "init_head", False))
     tx = build_optimizer(params, args,
                          schedule=make_schedule(args, total_steps))
-    state = init_state(init_key, cfg, tx, rng=train_rng, params=params)
+    state = init_state(init_key, cfg, tx, rng=train_rng, params=params,
+                       ema=getattr(args, "ema_decay", 0.0) > 0)
     return cfg, tx, state

@@ -40,17 +40,27 @@ def _unroll(args):
 
 
 def init_state(key: jax.Array, cfg: BertConfig, tx: optax.GradientTransformation,
-               rng: jax.Array = None, params=None) -> State:
+               rng: jax.Array = None, params=None, ema: bool = False) -> State:
     """Canonical train-state schema.  ``params`` may be passed pre-built
-    (e.g. already sharded) to avoid re-initializing the full tree."""
+    (e.g. already sharded) to avoid re-initializing the full tree.
+    ``ema=True`` adds an ``'ema'`` tree (initialized to the params) that the
+    train step maintains as an exponential moving average — the weights
+    eval/checkpointing then prefer (``--ema_decay``)."""
     if params is None:
         params = bert.init_params(key, cfg)
-    return {
+    state = {
         "params": params,
         "opt_state": tx.init(params),
         "step": jnp.zeros((), jnp.int32),
         "rng": rng if rng is not None else jax.random.key(0),
     }
+    if ema:
+        # jnp.copy, not asarray: distinct buffers, so a donated train step
+        # can never invalidate params and ema together.  (Inside a jit init
+        # XLA may still alias identical outputs — setup_sharded_model does
+        # a post-jit copy for that path.)
+        state["ema"] = jax.tree_util.tree_map(jnp.copy, params)
+    return state
 
 
 def weighted_ce(logits: jax.Array, labels: jax.Array, weights: jax.Array,
@@ -110,6 +120,8 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
             smoothing=smoothing)
         return objective + cfg.moe_aux_coef * aux, (loss, correct)
 
+    ema_decay = getattr(args, "ema_decay", 0.0)
+
     def train_step(state: State, batch: Dict[str, jax.Array]) -> Tuple[State, Metrics]:
         rng = jax.random.fold_in(state["rng"], state["step"])
         (_, (loss, correct)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -128,6 +140,16 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
             "step": state["step"] + 1,
             "rng": state["rng"],
         }
+        if "ema" in state:
+            # bias-corrected-free simple EMA: eval/checkpoint weights
+            # (Polyak averaging — smooths the tail of the LR schedule)
+            d = jnp.asarray(ema_decay, jnp.float32)
+            new_state["ema"] = jax.tree_util.tree_map(
+                lambda e, p: (d * e.astype(jnp.float32)
+                              + (1.0 - d) * p.astype(jnp.float32)
+                              ).astype(e.dtype) if hasattr(e, "dtype")
+                else e,
+                state["ema"], params)
         wsum = jnp.maximum(batch["example_weight"].sum(), 1.0)
         return new_state, {"loss": loss, "accuracy": correct / wsum}
 

@@ -57,6 +57,11 @@ class Trainer:
         self.best_accuracy = 0.0
         self._best_params = None  # device-held copy; written once at end
 
+    def _eval_params(self):
+        """Weights eval/checkpointing use: the EMA tree when the state
+        carries one (``--ema_decay``), else the live params."""
+        return self.state.get("ema", self.state["params"])
+
     # -------------------------------------------------- warmup / probe
     def warmup_compile(self, train_loader, dev_loader=None) -> None:
         """AOT-compile the step programs before the timed epoch (the
@@ -220,8 +225,16 @@ class Trainer:
             self._save(args.ckpt_path())
         elif self._best_params is not None:
             # adopt + persist the best-of-epoch params (the reference's
-            # best-checkpoint ritual; its test.py then evaluates that file)
+            # best-checkpoint ritual; its test.py then evaluates that file).
+            # Under EMA the snapshot IS averaged weights — both trees adopt
+            # it so the post-train test() evaluates exactly what was saved.
             self.state["params"] = self._best_params
+            if "ema" in self.state:
+                # distinct copy — assigning the same tree would alias the
+                # buffers and a further donated train step would invalidate
+                # both references
+                self.state["ema"] = jax.tree_util.tree_map(
+                    jax.numpy.copy, self._best_params)
             ckpt.save_params(args.ckpt_path(), {"params": self._best_params})
         return minutes
 
@@ -235,14 +248,16 @@ class Trainer:
         rank0_print(fmt_dev(loss, acc))
         if acc > self.best_accuracy:
             self.best_accuracy = acc
-            # jnp.copy: the live params are donated buffers; the copy is ours
+            # jnp.copy: the live params are donated buffers; the copy is
+            # ours.  With EMA enabled the averaged weights ARE the model
+            # being evaluated, so they are what "best" snapshots.
             self._best_params = jax.tree_util.tree_map(
-                jax.numpy.copy, self.state["params"])
+                jax.numpy.copy, self._eval_params())
             rank0_print(fmt_best(acc))
 
     def _save(self, path: str) -> None:
         # all processes enter (consolidate is collective); rank 0 writes
-        ckpt.save_params(path, self.state)
+        ckpt.save_params(path, {"params": self._eval_params()})
 
     # ---------------------------------------------------------------- resume
     def save_resume(self, path: str) -> None:
@@ -278,7 +293,7 @@ class Trainer:
         # Dispatch the whole pass first, fetch once at the end: a per-batch
         # float() would serialize host and device through the dev set (the
         # train loop's async-dispatch treatment, applied to eval).
-        pending = [self.eval_step(self.state["params"], self.put(batch))
+        pending = [self.eval_step(self._eval_params(), self.put(batch))
                    for batch in loader]
         fetched = jax.device_get(pending)
         y_true, y_pred = [], []

@@ -52,6 +52,12 @@ class Args:
     # --- optimization (single-gpu-cls.py:86-97,193-205) ---
     learning_rate: float = 3e-5
     label_smoothing: float = 0.0                  # CE target smoothing eps
+    ema_decay: float = 0.0                        # >0 keeps an exponential
+                                                  # moving average of params
+                                                  # on device; eval/best/
+                                                  # checkpoint use the EMA
+                                                  # weights (jit dp/zero/tp/
+                                                  # ep strategies)
     lr_schedule: Optional[str] = None             # warmup_linear|warmup_cosine
     warmup_ratio: float = 0.06                    # fraction of total steps
     weight_decay: float = 0.01
