@@ -87,18 +87,19 @@ def main() -> None:
     # for 4x fewer dispatches over the tunneled device transport — measured
     # 0.167 vs 0.269 min/epoch on a slow-tunnel day, a wash (~0.16-0.17)
     # on fast days.  --fuse_steps 1 restores per-step dispatch.
-    # Recipe (scripts/sweep_recipe*.py + sweep_sft.py sweeps): 2 fine-tune
-    # epochs with linear warmup->decay at 3e-5, trained head restored
-    # (init_head), weight EMA at decay 0.99 (evaluated/checkpointed weights
-    # are the Polyak average; decays 0.98/0.995 measured 0.5775 and 0.999
-    # 0.5687 — 0.99 is the swept optimum), best-of-epoch checkpointing (the
-    # reference's own eval-every-50-steps keep-the-best ritual) — measured
-    # 0.5813 dev accuracy from the MLM+sft5 pretrain (0.5787 without EMA;
+    # Recipe (scripts/sweep_recipe*.py + sweep_sft.py sweeps; EMA/epoch
+    # grid in results/ema_sweep.json): 3 fine-tune epochs with linear
+    # warmup->decay at 3e-5, trained head restored (init_head), weight EMA
+    # at decay 0.99 (evaluated/checkpointed weights are the Polyak
+    # average), best-of checkpointing (the reference's own
+    # eval-every-50-steps keep-the-best ritual) — measured 0.5825 dev
+    # accuracy from the MLM+sft5 pretrain (swept optimum: 2ep@0.99 0.5813,
+    # 4ep 0.5787, decay 0.985/0.995/0.999 all lower; 0.5787 without EMA;
     # the reference's pretrained checkpoint lands ~0.57, and 0.5763 under
     # its exact 1-epoch constant-LR protocol).
     args = parse_cli(base=Args(
         strategy="dp", dtype="bfloat16", fuse_steps=4,
-        epochs=2, lr_schedule="warmup_linear", ema_decay=0.99,
+        epochs=3, lr_schedule="warmup_linear", ema_decay=0.99,
         sft_epochs=5,        # measured best; --sft_epochs 0 = MLM-only warm start
         dev=True, eval_step=50,  # eval in-loop, keep best (reference protocol)
         log_every=10 ** 9,   # no per-step printing inside the timed loop
