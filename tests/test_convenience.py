@@ -128,3 +128,26 @@ def test_autotrainer_fused_steps(corpus_path, tmp_path):
     with pytest.raises(ValueError, match="must divide"):
         AutoTrainer(TrainerArgs(output_dir=str(tmp_path / "bad"),
                                 fuse_steps=3, **common))
+
+
+def test_autotrainer_zero_mode(corpus_path, tmp_path):
+    """mode="zero" — the knob HF Trainer delegates to DeepSpeed: the
+    managed run trains with fully-sharded state (per-device bytes ~1/ndev
+    of replicated) and still rotates/reloads checkpoints."""
+    from pdnlp_tpu.parallel import make_mesh, shard_fraction
+
+    targs = TrainerArgs(
+        output_dir=str(tmp_path / "auto0"), mode="zero", model="bert-tiny",
+        data_path=corpus_path, data_limit=400, max_seq_len=16,
+        eval_steps=2, save_steps=2, save_total_limit=2,
+        logging_steps=10 ** 6, num_train_epochs=1,
+    )
+    at = AutoTrainer(targs)
+    ndev = jax.device_count()
+    frac = shard_fraction(at._trainer.state, make_mesh())
+    assert frac < 1.5 / ndev, f"zero state not sharded: {frac}"
+    m = at.train()
+    assert m["global_step"] == len(at.train_loader)
+    e = at.evaluate()
+    assert 0.0 <= e["eval_accuracy"] <= 1.0
+    assert at.best_ckpt is not None and os.path.isdir(at.best_ckpt)
