@@ -55,6 +55,13 @@ _REGISTRY = {
     "bert-tiny-moe": BertConfig(hidden_size=128, num_layers=2, num_heads=2,
                                 intermediate_size=512, max_position=128,
                                 moe_experts=4),
+    # long-context variants: a 4x position table for the sequence-parallel
+    # (ring attention) path, whose whole point is sequences no single
+    # device wants to hold — each seq shard stores/attends seq/N locally
+    # and the position table covers the GLOBAL length
+    "bert-base-long": BertConfig(max_position=2048),
+    "bert-tiny-long": BertConfig(hidden_size=128, num_layers=2, num_heads=2,
+                                 intermediate_size=512, max_position=512),
 }
 
 

@@ -25,12 +25,13 @@ def sp_args(**kw):
     return Args(**base)
 
 
-def make_batch(n=16, seed=0):
+def make_batch(n=16, seed=0, seq=S, full_mask=False):
     r = np.random.RandomState(seed)
     b = {
-        "input_ids": r.randint(0, V, (n, S)).astype(np.int32),
-        "token_type_ids": np.zeros((n, S), np.int32),
-        "attention_mask": (r.rand(n, S) > 0.1).astype(np.int32),
+        "input_ids": r.randint(0, V, (n, seq)).astype(np.int32),
+        "token_type_ids": np.zeros((n, seq), np.int32),
+        "attention_mask": (np.ones((n, seq)) if full_mask
+                           else (r.rand(n, seq) > 0.1)).astype(np.int32),
         "label": r.randint(0, 6, (n,)).astype(np.int32),
         "example_weight": np.ones((n,), np.float32),
     }
@@ -109,18 +110,35 @@ def test_sp_long_sequence_beyond_single_shard(ndev):
     """The point of the path: a global sequence longer than any single
     shard's local length trains without materializing full-S activations."""
     args = sp_args(max_seq_len=16 * ndev)
-    n = 8
-    r = np.random.RandomState(2)
-    Sg = 16 * ndev
-    batch = {
-        "input_ids": r.randint(0, V, (n, Sg)).astype(np.int32),
-        "token_type_ids": np.zeros((n, Sg), np.int32),
-        "attention_mask": np.ones((n, Sg), np.int32),
-        "label": r.randint(0, 6, (n,)).astype(np.int32),
-        "example_weight": np.ones((n,), np.float32),
-    }
+    batch = make_batch(n=8, seed=2, seq=16 * ndev, full_mask=True)
     mesh = make_mesh(shape={"data": 1, "seq": ndev})
     cfg, tx, state = setup_model(args, V)
     step = make_sp_train_step(cfg, tx, args, mesh)(batch)
     state, m = step(state, make_sp_batch(mesh)(batch))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_sp_long_context_config_4x_table(ndev):
+    """The long-context configs pair with the ring: bert-tiny-long's 512
+    position table carries a global sequence 4x the base bert-tiny limit,
+    sharded 64-per-device over the seq axis, and reproduces the
+    single-device full-attention run at the same global length."""
+    Sg = 512
+    args = sp_args(model="bert-tiny-long", max_seq_len=Sg)
+    batch = make_batch(n=4, seed=3, seq=Sg, full_mask=True)
+    cfg, tx, state = setup_model(args, V)
+    sstate, sm = make_train_step(cfg, tx, args)(state, batch)
+
+    mesh = make_mesh(shape={"data": 1, "seq": ndev})
+    cfg2, tx2, state2 = setup_model(args, V)
+    step = make_sp_train_step(cfg2, tx2, args, mesh)(batch)
+    pstate, pm = step(state2, make_sp_batch(mesh)(batch))
+    assert float(pm["loss"]) == pytest.approx(float(sm["loss"]), rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(sstate["params"]),
+                    jax.tree_util.tree_leaves(pstate["params"])):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-5)
+    # the base config loudly refuses the same global length
+    short = sp_args(model="bert-tiny", max_seq_len=Sg)
+    cfg3, tx3, state3 = setup_model(short, V)
+    with pytest.raises(ValueError, match="max_position"):
+        make_train_step(cfg3, tx3, short)(state3, batch)
