@@ -10,6 +10,14 @@ owns the whole run: loop, eval every ``eval_steps``, a rotating
 ``compute_metrics`` hook (``:91-96``).  Parallelism is the framework's mesh
 DP — the analog of HF Trainer's implicit DDP — plus ``mode="zero"`` for
 fully-sharded, a knob HF Trainer delegates to DeepSpeed.
+
+Resume (HF's ``resume_from_checkpoint``): ``save_optimizer_state=True``
+writes a full train state per rotation dir and
+``resume_from_checkpoint="<dir>"|"latest"`` continues bitwise from it
+(params + Adam moments + step + RNG restored, seeded data order
+fast-forwarded).  Best-model TRACKING restarts at the resume point — the
+already-written rotation dirs keep their files, but a pre-crash best is
+re-discovered only if a post-resume eval beats it.
 """
 from __future__ import annotations
 
@@ -68,6 +76,16 @@ class TrainerArgs:
     # ``test_tpu.py`` sweeps are exact — only non-best rotation saves
     # (crash recovery points) stay bf16-rounded.
     save_dtype: str = "bfloat16"
+    # HF's resume story: save_optimizer_state=True additionally writes
+    # train_state.msgpack (params + Adam moments + step + RNG, full
+    # precision — the analog of HF's optimizer.pt/scheduler.pt/rng_state)
+    # into each rotation dir, and resume_from_checkpoint="<dir>" (or
+    # "latest") restores it and fast-forwards the seeded data order to the
+    # saved step — a bitwise continuation, like the elastic launcher's.
+    # Off by default: it doubles the per-save device fetch, which dominates
+    # the epoch on high-RTT transports (see save_dtype above).
+    save_optimizer_state: bool = False
+    resume_from_checkpoint: Optional[str] = None
     mode: str = "dp"                      # "zero" = the DeepSpeed delegation
     model: str = "bert-base"
     init_from: Optional[str] = None       # model_name_or_path analog (pretrain ckpt)
@@ -146,6 +164,18 @@ class AutoTrainer:
         self._trainer, self.train_loader, self.dev_loader = build_parallel_trainer(
             self.args, mode=targs.mode)
         self.state_history: List[Tuple[int, str]] = []  # (step, ckpt_dir)
+        if targs.resume_from_checkpoint:
+            # adopt the pre-crash rotation dirs so save_total_limit keeps
+            # bounding TOTAL disk across crash/resume cycles (HF scans the
+            # on-disk dirs the same way)
+            import glob
+            import re as _re
+
+            for d in glob.glob(os.path.join(targs.output_dir, "checkpoint-*")):
+                m = _re.fullmatch(r"checkpoint-(\d+)", os.path.basename(d))
+                if m:
+                    self.state_history.append((int(m.group(1)), d))
+            self.state_history.sort()
         self.best_metric: Optional[float] = None
         self.best_ckpt: Optional[str] = None
         self._best_params = None  # full-precision best copy, device-held
@@ -158,6 +188,12 @@ class AutoTrainer:
         targs = self.targs
         gstep = 0
         total = len(self.train_loader) * targs.num_train_epochs
+        start_step = 0
+        if targs.resume_from_checkpoint:
+            state_path = self._resolve_resume(targs.resume_from_checkpoint)
+            t.load_resume(state_path)
+            start_step = int(jax.device_get(t.state["step"]))
+            rank0_print(f"resumed from {state_path} at step {start_step}")
         # compile outside the reported train_runtime (every strategy row is
         # timed against a warm compile; the reference's runs sit on a warm
         # CUDA context + cudnn autotune cache the same way)
@@ -173,6 +209,26 @@ class AutoTrainer:
             # the divisibility guard in __init__ makes exact
             for batch, n, fused in t._macro_batches(self.train_loader,
                                                     targs.fuse_steps):
+                if gstep + n <= start_step:
+                    # fast-forward a resumed run: the sampler is a seeded
+                    # permutation, so skipping exactly the done steps
+                    # replays the identical remaining stream (the bitwise-
+                    # resume contract of Trainer.train / the elastic
+                    # launcher); cadence actions for done steps are
+                    # skipped too — their checkpoints already exist
+                    gstep += n
+                    continue
+                if gstep < start_step:
+                    # the restored step falls INSIDE this fused group:
+                    # executing it would silently re-apply already-applied
+                    # updates — a resume must use a fuse_steps whose group
+                    # boundaries include the checkpoint's step
+                    raise ValueError(
+                        f"resume step {start_step} is not a fused-group "
+                        f"boundary under fuse_steps={targs.fuse_steps} "
+                        f"(group covers steps {gstep + 1}..{gstep + n}) — "
+                        "resume with the fuse_steps the checkpoint was "
+                        "saved under, or 1")
                 if fused:
                     t.state, metrics = t.multi_step(t.state,
                                                     t.put_fused(batch))
@@ -217,9 +273,12 @@ class AutoTrainer:
                     restored, t.state["params"])
             rank0_print(f"loaded best model ({targs.metric_for_best_model}="
                         f"{self.best_metric:.4f}) from {self.best_ckpt}")
-        n_examples = total * self.args.train_batch_size
+        # only steps actually executed this run count toward throughput —
+        # a resumed run's fast-forwarded steps trained in a previous life
+        n_examples = (gstep - start_step) * self.args.train_batch_size
         return {"train_runtime": runtime,
-                "train_samples_per_second": n_examples / runtime,
+                "train_samples_per_second":
+                    n_examples / runtime if runtime > 0 else 0.0,
                 "global_step": gstep}
 
     # ----------------------------------------------------------------- eval
@@ -256,6 +315,31 @@ class AutoTrainer:
     def _ckpt_dir(self, gstep: int) -> str:
         return os.path.join(self.targs.output_dir, f"checkpoint-{gstep}")
 
+    def _resolve_resume(self, spec: str) -> str:
+        """``resume_from_checkpoint``: a checkpoint dir, a train_state file,
+        or "latest" (newest rotation dir that has a train_state)."""
+        if spec == "latest":
+            import glob
+
+            cands = sorted(
+                glob.glob(os.path.join(self.targs.output_dir, "checkpoint-*",
+                                       "train_state.msgpack")),
+                key=lambda p: int(p.split("checkpoint-")[-1].split(os.sep)[0]))
+            if not cands:
+                raise FileNotFoundError(
+                    f"no checkpoint-*/train_state.msgpack under "
+                    f"{self.targs.output_dir} — resumable checkpoints need "
+                    "save_optimizer_state=True")
+            return cands[-1]
+        if os.path.isdir(spec):
+            spec = os.path.join(spec, "train_state.msgpack")
+        if not os.path.exists(spec):
+            raise FileNotFoundError(
+                f"{spec} not found — resumable checkpoints are written only "
+                "under save_optimizer_state=True (params-only rotation saves "
+                "cannot restore the optimizer)")
+        return spec
+
     def _save_checkpoint(self, gstep: int) -> None:
         """Checkpoint WITHOUT stalling the device: snapshot params in HBM
         cast to ``save_dtype`` (the live buffers are donated; the cast also
@@ -275,6 +359,13 @@ class AutoTrainer:
         if any(dir_ == d for _, dir_ in self.state_history):
             return  # already written this step (best-model save + save_steps)
         path = os.path.join(d, "model.msgpack")
+        if self.targs.save_optimizer_state:
+            # the resume artifact (params + moments + step + RNG), written
+            # SYNCHRONOUSLY from the live state between steps — full
+            # precision by necessity (bitwise resume), which is exactly why
+            # it is opt-in: it adds a full-state fetch per save
+            ckpt.save_state(os.path.join(d, "train_state.msgpack"),
+                            self._trainer.state)
         if jax.process_count() > 1:
             ckpt.save_params(path, {
                 "params": _cast_like(self._trainer.state["params"],
