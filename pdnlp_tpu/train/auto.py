@@ -164,6 +164,14 @@ class AutoTrainer:
         self._trainer, self.train_loader, self.dev_loader = build_parallel_trainer(
             self.args, mode=targs.mode)
         self.state_history: List[Tuple[int, str]] = []  # (step, ckpt_dir)
+        if targs.resume_from_checkpoint and not targs.save_optimizer_state \
+                and targs.save_total_limit is not None:
+            raise ValueError(
+                "resume_from_checkpoint with params-only rotation saves "
+                "would rotate away the pre-crash train_state.msgpack dirs — "
+                "the run's ONLY recovery points if it crashes again.  Pass "
+                "save_optimizer_state=True (keep writing resumable "
+                "checkpoints) or save_total_limit=None (never rotate)")
         if targs.resume_from_checkpoint:
             # adopt the pre-crash rotation dirs so save_total_limit keeps
             # bounding TOTAL disk across crash/resume cycles (HF scans the
@@ -193,6 +201,11 @@ class AutoTrainer:
             state_path = self._resolve_resume(targs.resume_from_checkpoint)
             t.load_resume(state_path)
             start_step = int(jax.device_get(t.state["step"]))
+            if start_step > total:
+                raise ValueError(
+                    f"resume checkpoint is at step {start_step} but this "
+                    f"configuration trains only {total} steps — the resumed "
+                    "run's epochs/data do not match the saved run's")
             rank0_print(f"resumed from {state_path} at step {start_step}")
         # compile outside the reported train_runtime (every strategy row is
         # timed against a warm compile; the reference's runs sit on a warm
@@ -275,7 +288,7 @@ class AutoTrainer:
                         f"{self.best_metric:.4f}) from {self.best_ckpt}")
         # only steps actually executed this run count toward throughput —
         # a resumed run's fast-forwarded steps trained in a previous life
-        n_examples = (gstep - start_step) * self.args.train_batch_size
+        n_examples = max(0, gstep - start_step) * self.args.train_batch_size
         return {"train_runtime": runtime,
                 "train_samples_per_second":
                     n_examples / runtime if runtime > 0 else 0.0,

@@ -180,6 +180,15 @@ class Trainer:
                     if heartbeat is not None:  # long fast-forwards stay live
                         heartbeat.beat()
                     continue
+                if gstep < start_step:
+                    # the restored step falls inside this fused group:
+                    # executing it would re-apply updates the restored
+                    # optimizer state already contains
+                    raise ValueError(
+                        f"resume step {start_step} is not a fused-group "
+                        f"boundary under fuse_steps={fuse} (group covers "
+                        f"steps {gstep + 1}..{gstep + n}) — resume with the "
+                        "fuse_steps the snapshot was saved under, or 1")
                 if fault_step and start_step == 0 and gstep >= fault_step \
                         and jax.process_index() == fault_proc:
                     os._exit(13)

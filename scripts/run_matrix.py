@@ -74,9 +74,10 @@ RUNS = [
                                      "--bf16", "true", *PRETRAIN],
      {}, None,
      "save/eval every 50 steps, bf16 rotation saves, best-model reload; "
-     "row is save-transport-bound: 6 x 205MB fetches at the tunnel's "
-     "measured ~8MB/s floor the epoch at ~2.6 min regardless of step "
-     "speed (fusion changes nothing — see README)"),
+     "row is save-transport-bound: 6 x 205MB checkpoint fetches ride the "
+     "tunnel, whose bulk bandwidth swings run to run — identical reruns "
+     "measured 1.56 (fast period) to 7.68 min (slow); fusion changes "
+     "nothing, confirming bytes not dispatches (see README)"),
     ("sp (ring attention, seq 512)", [sys.executable, "multi-tpu-sp-cls.py",
                                       "--max_seq_len", "512",
                                       "--train_batch_size", "8",
