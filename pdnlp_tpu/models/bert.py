@@ -33,6 +33,20 @@ from pdnlp_tpu.ops.attention import dot_product_attention, mask_bias
 Params = Dict[str, Any]
 
 
+def _fuse_qkv() -> bool:
+    """Whether attention computes q/k/v as ONE fused [H, 3H] matmul.
+
+    Trace-time switch (``PDNLP_FUSE_QKV``), default OFF: the fused form is
+    the textbook win on GPU, but on v5e it measured 3% SLOWER than three
+    separate projections (33.1 -> 32.0 probe steps/s — XLA materializes the
+    weight concat each step instead of folding it; results/profile_r05.json)
+    and the split form keeps tp's per-tensor output sharding natural.  The
+    path stays for A/B profiling on other TPU generations."""
+    import os
+
+    return os.environ.get("PDNLP_FUSE_QKV", "0") == "1"
+
+
 # --------------------------------------------------------------------------
 # init
 # --------------------------------------------------------------------------
@@ -120,6 +134,8 @@ def _dense(x, p, dtype):
 
 
 def _dropout(x, rate, key):
+    if rate <= 0.0:  # trace-time constant: rate-0 configs skip mask codegen
+        return x
     keep = 1.0 - rate
     mask = jax.random.bernoulli(key, keep, x.shape)
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
@@ -247,9 +263,21 @@ def run_layers(layers: Params, cfg: BertConfig, x: jax.Array, *,
         def heads(t):
             return t.reshape(B, S, N, D)
 
-        q = heads(_dense(x, lp["q"], dtype))
-        k = heads(_dense(x, lp["k"], dtype))
-        v = heads(_dense(x, lp["v"], dtype))
+        if _fuse_qkv():
+            # one [H, 3H] projection: x is read from HBM once instead of
+            # three times and XLA tiles a single larger MXU matmul.  Params
+            # stay stored as separate q/k/v trees (checkpoint + tp-sharding
+            # compatibility); the concat below is trace-time weight reshaping
+            # that XLA folds into the matmul's operand layout.
+            w = jnp.concatenate([lp["q"]["kernel"], lp["k"]["kernel"],
+                                 lp["v"]["kernel"]], -1).astype(dtype)
+            bqkv = jnp.concatenate([lp["q"]["bias"], lp["k"]["bias"],
+                                    lp["v"]["bias"]], -1).astype(dtype)
+            q, k, v = (heads(t) for t in jnp.split(x @ w + bqkv, 3, -1))
+        else:
+            q = heads(_dense(x, lp["q"], dtype))
+            k = heads(_dense(x, lp["k"], dtype))
+            v = heads(_dense(x, lp["v"], dtype))
         if seq_axis is not None:
             from pdnlp_tpu.ops.ring import ring_attention
 

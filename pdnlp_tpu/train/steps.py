@@ -63,6 +63,24 @@ def init_state(key: jax.Array, cfg: BertConfig, tx: optax.GradientTransformation
     return state
 
 
+def cast_kernels(params, dtype):
+    """Cast every ``kernel`` leaf with >=2 dims to ``dtype``, leaving
+    embeddings, LayerNorm scales, and biases in fp32.
+
+    The rule matches exactly the leaves ``bert._dense`` casts per-use, so a
+    forward through the cast tree is bitwise identical to one through the
+    fp32 masters — only gradient *materialization* changes dtype."""
+
+    def cast(path, leaf):
+        last = path[-1]
+        if (getattr(last, "key", None) == "kernel"
+                and getattr(leaf, "ndim", 0) >= 2):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
 def weighted_ce(logits: jax.Array, labels: jax.Array, weights: jax.Array,
                 smoothing: float = 0.0
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -121,12 +139,34 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
         return objective + cfg.moe_aux_coef * aux, (loss, correct)
 
     ema_decay = getattr(args, "ema_decay", 0.0)
+    bf16_grads = dtype != jnp.float32 and getattr(args, "grads_dtype",
+                                                  "param") == "compute"
 
     def train_step(state: State, batch: Dict[str, jax.Array]) -> Tuple[State, Metrics]:
         rng = jax.random.fold_in(state["rng"], state["step"])
+        params = state["params"]
+        if bf16_grads:
+            # Pre-cast the big matmul kernels to the compute dtype OUTSIDE
+            # the differentiated function, so their gradients are *produced*
+            # in bf16 — the AMP analog of fp16 grads on the wire
+            # (/root/reference/multi-gpu-distributed-mp-amp-cls.py:167-175
+            # keeps fp16 grads until the unscale).  Forward math is bitwise
+            # unchanged (the kernels were cast per-use inside loss_fn
+            # anyway); what changes is the backward's materialization: grad
+            # assembly for the [L,...]-stacked kernels (dynamic-update-slice
+            # chains) moves half the bytes.  The mu/nu ACCUMULATORS stay
+            # fp32, but each increment is computed from the bf16 grad (nu's
+            # g**2 squares in bf16) — measured NEUTRAL to -6% on v5e and
+            # non-default for that reason (results/profile_r05.json).
+            params = cast_kernels(params, dtype)
         (_, (loss, correct)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], batch, rng
+            params, batch, rng
         )
+        # bf16 grads flow into the optimizer AS bf16: Adam's moment
+        # arithmetic promotes them to fp32 per-element inside the fused
+        # update loops (an explicit tree-wide upcast here measured as a
+        # no-op — XLA pushes the convert back into the grad-assembly chain,
+        # rebuilding the fp32 DUS traffic the cast exists to avoid).
         opt_in = state["opt_state"]
         if opt_staging is not None:
             opt_in = jax.device_put(opt_in, opt_staging[0])   # host -> device

@@ -75,6 +75,14 @@ class Args:
 
     # --- TPU-native knobs (replace AMP / ZeRO / launcher flags) ---
     dtype: str = "float32"                        # "bfloat16" = the AMP analog
+    grads_dtype: str = "param"                    # "param": fp32 grads (default).
+                                                  # "compute": kernel grads
+                                                  # materialize in the compute
+                                                  # dtype — measured NEUTRAL
+                                                  # to -6% on v5e (XLA re-fuses
+                                                  # the assembly worse); kept
+                                                  # for A/B (results/
+                                                  # profile_r05.json)
     rng_impl: str = "rbg"                         # dropout PRNG (utils.seeding.train_key)
     strategy: str = "single"                      # single|pmap|dp|shardmap|zero|...
     mode: str = "dp"                              # spawn launcher sharding mode:
