@@ -25,7 +25,14 @@ from pdnlp_tpu.utils.metrics import classification_report
 
 
 def main(args: Args) -> float:
-    accelerator = Accelerator(args)
+    if args.accel_config:
+        # machine config as a FILE (the reference ships default_config.yaml
+        # and feeds it via `accelerate launch --config_file`): mesh shape /
+        # precision / rendezvous come from the file, CLI args fill the rest
+        accelerator = Accelerator.from_config(args.accel_config, args=args)
+        args = accelerator.args
+    else:
+        accelerator = Accelerator(args)
 
     # user-style single-device setup (the reference's main() body).
     # total_steps for the LR schedule must reflect the POST-prepare() loader:

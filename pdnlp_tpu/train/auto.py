@@ -15,17 +15,21 @@ Resume (HF's ``resume_from_checkpoint``): ``save_optimizer_state=True``
 writes a full train state per rotation dir and
 ``resume_from_checkpoint="<dir>"|"latest"`` continues bitwise from it
 (params + Adam moments + step + RNG restored, seeded data order
-fast-forwarded).  Best-model TRACKING restarts at the resume point — the
-already-written rotation dirs keep their files, but a pre-crash best is
-re-discovered only if a post-resume eval beats it.
+fast-forwarded).  Best-model tracking survives the crash too: each
+resumable save writes a ``trainer_state.json`` (HF's file of the same
+name) and resume restores ``best_metric``/``best_ckpt`` from it, so a
+post-resume run that never beats the pre-crash best still ships it.
+
+The training LOOP itself lives in ``Trainer.train`` — this class only
+supplies managed-cadence callbacks (``LoopHooks``); see ``train()``.
 """
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import shutil
 import threading
-import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -114,6 +118,11 @@ class TrainerArgs:
             init_from=self.init_from,
             init_head=self.init_head,
             fuse_steps=self.fuse_steps,
+            # the shared loop gates in-loop eval on dev, and the managed
+            # runtime is reported against a warm compile (HF runs sit on a
+            # warm CUDA context the same way)
+            dev=True,
+            warmup_compile=True,
         )
 
 
@@ -192,77 +201,41 @@ class AutoTrainer:
 
     # ---------------------------------------------------------------- train
     def train(self) -> Dict[str, float]:
+        """Managed run, driven by the ONE loop in ``Trainer.train``: this
+        method only supplies the managed cadence callbacks (HF-style log
+        line, eval-and-track-best, rotation checkpointing) via ``LoopHooks``
+        — the epoch/fused-group machinery, elastic fast-forward, fused-
+        boundary guard, heartbeat and profiler all come from the shared
+        driver instead of a second copy of it."""
+        from pdnlp_tpu.train.trainer import LoopHooks
+
         t = self._trainer
         targs = self.targs
-        gstep = 0
-        total = len(self.train_loader) * targs.num_train_epochs
         start_step = 0
         if targs.resume_from_checkpoint:
             state_path = self._resolve_resume(targs.resume_from_checkpoint)
             t.load_resume(state_path)
             start_step = int(jax.device_get(t.state["step"]))
-            if start_step > total:
-                raise ValueError(
-                    f"resume checkpoint is at step {start_step} but this "
-                    f"configuration trains only {total} steps — the resumed "
-                    "run's epochs/data do not match the saved run's")
             rank0_print(f"resumed from {state_path} at step {start_step}")
-        # compile outside the reported train_runtime (every strategy row is
-        # timed against a warm compile; the reference's runs sit on a warm
-        # CUDA context + cudnn autotune cache the same way)
-        t.warmup_compile(self.train_loader, self.dev_loader)
-        start = time.time()
-        metrics = None
-        last_loss = None
-        for epoch in range(1, targs.num_train_epochs + 1):
-            self.train_loader.set_epoch(epoch - 1)
-            # fused groups ride one device dispatch per K steps (the other
-            # strategies' fuse_steps, Trainer._macro_batches does the
-            # stacking); cadence checks below fire once per group, which
-            # the divisibility guard in __init__ makes exact
-            for batch, n, fused in t._macro_batches(self.train_loader,
-                                                    targs.fuse_steps):
-                if gstep + n <= start_step:
-                    # fast-forward a resumed run: the sampler is a seeded
-                    # permutation, so skipping exactly the done steps
-                    # replays the identical remaining stream (the bitwise-
-                    # resume contract of Trainer.train / the elastic
-                    # launcher); cadence actions for done steps are
-                    # skipped too — their checkpoints already exist
-                    gstep += n
-                    continue
-                if gstep < start_step:
-                    # the restored step falls INSIDE this fused group:
-                    # executing it would silently re-apply already-applied
-                    # updates — a resume must use a fuse_steps whose group
-                    # boundaries include the checkpoint's step
-                    raise ValueError(
-                        f"resume step {start_step} is not a fused-group "
-                        f"boundary under fuse_steps={targs.fuse_steps} "
-                        f"(group covers steps {gstep + 1}..{gstep + n}) — "
-                        "resume with the fuse_steps the checkpoint was "
-                        "saved under, or 1")
-                if fused:
-                    t.state, metrics = t.multi_step(t.state,
-                                                    t.put_fused(batch))
-                    last_loss = metrics["loss"][-1]
-                else:
-                    t.state, metrics = t.train_step(t.state, t.put(batch))
-                    last_loss = metrics["loss"]
-                prev = gstep
-                gstep += n
-                if gstep // targs.logging_steps != prev // targs.logging_steps:
-                    rank0_print(f"step {gstep}/{total} "
-                                f"loss {float(last_loss):.4f}")
-                if gstep // targs.eval_steps != prev // targs.eval_steps:
-                    self._eval_and_log(gstep)
-                if gstep // targs.save_steps != prev // targs.save_steps:
-                    self._save_checkpoint(gstep)
-        if last_loss is not None:
-            float(jax.device_get(last_loss))  # completion barrier
-        self._drain_writers()   # all checkpoint files durable before reload
-        self._rotate()
-        runtime = time.time() - start
+            # HF restores best-model tracking from trainer_state.json; so do
+            # we — without it a resumed run whose post-resume evals never
+            # beat the pre-crash best would silently ship a worse final
+            # model (and rotation could delete the pre-crash best dir)
+            self._restore_trainer_state(os.path.dirname(state_path))
+        hooks = LoopHooks(
+            on_log=lambda e, g, tot, loss: rank0_print(
+                f"step {g}/{tot} loss {loss:.4f}"),
+            on_eval=self._eval_and_log,
+            save_every=targs.save_steps,
+            on_save=self._save_checkpoint,
+            # writer drain + rotation are durability work the reported
+            # train_runtime must include (files must exist before reload)
+            on_end=lambda: (self._drain_writers(), self._rotate()),
+            end_save=False,  # best-model adoption below, not Trainer's ritual
+        )
+        minutes = t.train(self.train_loader, self.dev_loader, hooks=hooks)
+        runtime = minutes * 60
+        gstep = int(jax.device_get(t.state["step"]))
         if targs.load_best_model_at_end and self.best_ckpt:
             if self._best_params is not None:
                 # the exact full-precision params of the best eval step,
@@ -353,6 +326,29 @@ class AutoTrainer:
                 "cannot restore the optimizer)")
         return spec
 
+    def _restore_trainer_state(self, ckpt_dir: str) -> None:
+        """Restore best-model tracking from the checkpoint's
+        ``trainer_state.json`` (HF Trainer's file of the same name).  A
+        missing file (pre-r5 checkpoint) degrades to fresh tracking — the
+        pre-crash best is then only re-discovered if beaten."""
+        path = os.path.join(ckpt_dir, "trainer_state.json")
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            saved = json.load(f)
+        best_ckpt = saved.get("best_ckpt")
+        if best_ckpt and not os.path.isdir(best_ckpt):
+            rank0_print(f"saved best checkpoint {best_ckpt} no longer "
+                        "exists; best-model tracking restarts")
+            return
+        self.best_metric = saved.get("best_metric")
+        self.best_ckpt = best_ckpt
+        if self.best_metric is not None:
+            rank0_print(
+                f"restored best {self.targs.metric_for_best_model}="
+                f"{self.best_metric:.4f} from {self.best_ckpt} "
+                "(rotation will keep protecting it)")
+
     def _save_checkpoint(self, gstep: int) -> None:
         """Checkpoint WITHOUT stalling the device: snapshot params in HBM
         cast to ``save_dtype`` (the live buffers are donated; the cast also
@@ -379,6 +375,13 @@ class AutoTrainer:
             # it is opt-in: it adds a full-state fetch per save
             ckpt.save_state(os.path.join(d, "train_state.msgpack"),
                             self._trainer.state)
+            if jax.process_index() == 0:
+                # the trainer_state.json analog: best-model tracking must
+                # survive a crash/resume cycle (restored by train())
+                with open(os.path.join(d, "trainer_state.json"), "w") as f:
+                    json.dump({"best_metric": self.best_metric,
+                               "best_ckpt": self.best_ckpt,
+                               "global_step": gstep}, f)
         if jax.process_count() > 1:
             ckpt.save_params(path, {
                 "params": _cast_like(self._trainer.state["params"],

@@ -18,6 +18,7 @@ reference instead syncs every step (`.item()` after an explicit barrier).
 """
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 from typing import Callable, Dict, Optional, Tuple
@@ -30,6 +31,34 @@ from pdnlp_tpu.utils.logging import (
     fmt_best, fmt_dev, fmt_elapsed_minutes, fmt_train, rank0_print,
 )
 from pdnlp_tpu.utils.profiling import Profiler, StepStats
+
+
+@dataclasses.dataclass
+class LoopHooks:
+    """Cadence callbacks for ``Trainer.train`` — ONE epoch/fused-group/
+    cadence driver serves both the reference-style Trainer and the managed
+    ``AutoTrainer`` (which supplies rotation-checkpoint and best-model
+    callbacks here instead of re-implementing the loop; heartbeat, profiler,
+    elastic fast-forward, and the fused-boundary guard therefore work
+    identically on both paths).
+
+    Every hook receives resolved host values (the loop's async-dispatch
+    discipline is preserved around them)."""
+
+    # replaces the 【train】 log line: (epoch, gstep, total_step, loss)
+    on_log: Optional[Callable[[int, int, int, float], None]] = None
+    # replaces Trainer._dev_and_maybe_save at the eval_step cadence: (gstep)
+    on_eval: Optional[Callable[[int], None]] = None
+    # extra cadence (e.g. TrainerArgs.save_steps) + its callback: (gstep)
+    save_every: Optional[int] = None
+    on_save: Optional[Callable[[int], None]] = None
+    # runs after the completion barrier but BEFORE the wall-clock stops —
+    # work that must count toward the reported runtime (e.g. draining async
+    # checkpoint writers so every file is durable)
+    on_end: Optional[Callable[[], None]] = None
+    # Trainer's native end-of-run ritual (save checkpoint / adopt best);
+    # False when the caller owns checkpointing (AutoTrainer)
+    end_save: bool = True
 
 
 class Trainer:
@@ -56,6 +85,10 @@ class Trainer:
         self.put_fused = put_fused or self.put
         self.best_accuracy = 0.0
         self._best_params = None  # device-held copy; written once at end
+        # (minutes-since-train-start, dev accuracy) per in-loop eval: the
+        # time-to-accuracy record bench.py reports (minutes_to_target)
+        self.eval_history: list = []
+        self._t0: Optional[float] = None
 
     def _eval_params(self):
         """Weights eval/checkpointing use: the EMA tree when the state
@@ -92,7 +125,10 @@ class Trainer:
         controlled per-strategy speed metric, free of loader/eval/transport
         effects.  Returns None when unsupported (host-offloaded moments:
         ``jnp.copy`` would silently move them on-device and probe a
-        different program)."""
+        different program) — and None, not a crash, when the state copy
+        itself OOMs: the copy transiently doubles the state's HBM, so a
+        near-capacity config that trains fine must still complete its run
+        with ``probe n/a`` rather than die inside the probe."""
         if getattr(self.args, "offload_opt_state", False):
             return None
         host = next(iter(train_loader), None)
@@ -101,17 +137,24 @@ class Trainer:
         import jax.numpy as jnp
 
         batch = self.put(host)
-        state = jax.tree_util.tree_map(jnp.copy, self.state)
-        m = None
-        for _ in range(3):
-            state, m = self.train_step(state, batch)
-        float(jax.device_get(m["loss"]))
-        t0 = time.time()
-        for _ in range(n):
-            state, m = self.train_step(state, batch)
-        float(jax.device_get(m["loss"]))
-        dt = time.time() - t0
-        del state, m
+        state = m = None
+        try:
+            state = jax.tree_util.tree_map(jnp.copy, self.state)
+            for _ in range(3):
+                state, m = self.train_step(state, batch)
+            float(jax.device_get(m["loss"]))
+            t0 = time.time()
+            for _ in range(n):
+                state, m = self.train_step(state, batch)
+            float(jax.device_get(m["loss"]))
+            dt = time.time() - t0
+        except jax.errors.JaxRuntimeError as e:  # RESOURCE_EXHAUSTED et al.
+            if "RESOURCE_EXHAUSTED" not in str(e):
+                raise
+            rank0_print("probe skipped: state copy exceeds device memory")
+            return None
+        finally:
+            del state, m  # release the doubled state promptly
         return n / dt if dt > 0 else None
 
     def _macro_batches(self, loader, k: int):
@@ -132,7 +175,8 @@ class Trainer:
             yield b, 1, False
 
     # ------------------------------------------------------------------ train
-    def train(self, train_loader, dev_loader=None) -> float:
+    def train(self, train_loader, dev_loader=None,
+              hooks: Optional[LoopHooks] = None) -> float:
         """Run ``args.epochs`` epochs; returns wall-clock minutes.
 
         Elastic hooks (all off by default):  a state restored via
@@ -140,14 +184,24 @@ class Trainer:
         counter and continues bitwise; ``args.resume_every`` snapshots full
         state every N steps; ``args.heartbeat_interval`` beats a liveness
         file for the launcher-side ``GangMonitor``.
+
+        ``hooks`` (``LoopHooks``) swaps the log/eval/save behaviors at the
+        existing cadences without duplicating the loop — the managed
+        ``AutoTrainer`` path runs through here.
         """
         args = self.args
+        hooks = hooks or LoopHooks()
         total_step = len(train_loader) * args.epochs
         gstep = 0
         # fast-forward: a restored state carries the step it was saved at;
         # the sampler is a seeded permutation, so skipping exactly that many
         # batches replays the identical remaining stream (bitwise resume)
         start_step = int(jax.device_get(self.state["step"]))
+        if start_step > total_step:
+            raise ValueError(
+                f"restored state is at step {start_step} but this "
+                f"configuration trains only {total_step} steps — the "
+                "resumed run's epochs/data do not match the saved run's")
         pending: Tuple[int, int, jax.Array] | None = None  # (epoch, gstep, loss)
         last_loss = None
         profiler = Profiler(getattr(args, "profile_dir", None))
@@ -172,6 +226,7 @@ class Trainer:
             if rate is not None:
                 rank0_print(f"probe steps/s：{rate:.2f}")
         start = time.time()
+        self._t0 = start
         for epoch in range(1, args.epochs + 1):
             train_loader.set_epoch(epoch - 1)
             for batch, n, fused in self._macro_batches(train_loader, fuse):
@@ -210,14 +265,32 @@ class Trainer:
                 if gstep // args.log_every != prev // args.log_every:
                     if pending is not None:  # print the *previous* line's loss:
                         e, s, l = pending     # it is done by now — no sync stall
-                        rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
+                        if hooks.on_log is not None:
+                            hooks.on_log(e, s, total_step, float(l))
+                        else:
+                            rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
                     pending = (epoch, gstep, last_loss)
+                # boundary-crossing, not equality: with fuse_steps=K the
+                # counter advances K at a time, so when K does not divide
+                # eval_step the eval lands up to K-1 steps late (count per
+                # epoch preserved).  Pick eval_step divisible by fuse_steps
+                # (bench.py: 48 under K=4) for exact reference cadence;
+                # AutoTrainer instead rejects non-divisible combinations.
                 if dev_loader is not None and args.dev and \
                         gstep // args.eval_step != prev // args.eval_step:
-                    self._dev_and_maybe_save(dev_loader)
+                    if hooks.on_eval is not None:
+                        hooks.on_eval(gstep)
+                    else:
+                        self._dev_and_maybe_save(dev_loader)
+                if hooks.save_every and hooks.on_save is not None and \
+                        gstep // hooks.save_every != prev // hooks.save_every:
+                    hooks.on_save(gstep)
         if pending is not None:
             e, s, l = pending
-            rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
+            if hooks.on_log is not None:
+                hooks.on_log(e, s, total_step, float(l))
+            else:
+                rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
         # True completion barrier: fetch a VALUE from the last enqueued
         # program.  Device programs execute in order, so the fetch cannot
         # return before every prior step has run.  block_until_ready alone
@@ -227,10 +300,14 @@ class Trainer:
             float(jax.device_get(last_loss))
         jax.block_until_ready(self.state["params"])
         profiler.close()
+        if hooks.on_end is not None:
+            hooks.on_end()  # durability work that must count in the runtime
         minutes = (time.time() - start) / 60
         rank0_print(fmt_elapsed_minutes(minutes))
         rank0_print(StepStats(gstep, examples, minutes).line())
-        if not args.dev:
+        if not hooks.end_save:
+            pass  # the caller owns checkpointing (AutoTrainer)
+        elif not args.dev:
             self._save(args.ckpt_path())
         elif self._best_params is not None:
             # adopt + persist the best-of-epoch params (the reference's
@@ -255,6 +332,11 @@ class Trainer:
         behind checkpoint I/O)."""
         loss, acc = self.dev(dev_loader)
         rank0_print(fmt_dev(loss, acc))
+        if self._t0 is not None:
+            # dev() fetched values, so every prior train step has completed:
+            # the elapsed time honestly covers the compute that produced acc
+            self.eval_history.append(
+                {"minutes": (time.time() - self._t0) / 60, "accuracy": acc})
         if acc > self.best_accuracy:
             self.best_accuracy = acc
             # jnp.copy: the live params are donated buffers; the copy is

@@ -113,6 +113,10 @@ class Args:
                                                   # strategies), "seq" (sp),
                                                   # and "model" (tp), e.g.
                                                   # {"data": 2, "model": 4}
+    accel_config: Optional[str] = None            # Accelerator machine-config
+                                                  # file (JSON/YAML, the
+                                                  # default_config.yaml
+                                                  # analog — accel.py)
     prefetch: int = 2                             # host->device pipeline depth
     log_every: int = 1
     profile_dir: Optional[str] = None             # jax.profiler trace output

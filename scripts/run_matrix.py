@@ -77,7 +77,7 @@ RUNS = [
      "row is save-transport-bound: 6 x 205MB checkpoint fetches ride the "
      "tunnel, whose bulk bandwidth swings run to run — identical reruns "
      "measured 1.56 (fast period) to 7.68 min (slow); fusion changes "
-     "nothing, confirming bytes not dispatches (see README)"),
+     "nothing, confirming bytes not dispatches (see README)", 3),
     ("sp (ring attention, seq 512)", [sys.executable, "multi-tpu-sp-cls.py",
                                       "--max_seq_len", "512",
                                       "--train_batch_size", "8",
@@ -125,7 +125,23 @@ RE_RUNTIME = re.compile(r"'train_runtime': ([\d.]+)")
 TRANSIENT = ("remote_compile", "read body", "DEADLINE_EXCEEDED")
 
 
-def run_row(name, argv, env_over, ckpt_path, note, timeout):
+def run_row(name, argv, env_over, ckpt_path, note, timeout, repeat=1):
+    """One strategy row.  ``repeat`` > 1 re-runs the command back-to-back and
+    reports the MEDIAN minutes (each attempt kept in ``runs_min``) — used for
+    the transport-bound trainer row, where identical reruns measured 1.56 to
+    7.68 min purely with tunnel bandwidth."""
+    if repeat > 1:
+        rows = [run_row(name, argv, env_over, ckpt_path, note, timeout)
+                for _ in range(repeat)]
+        ok = [r for r in rows if "error" not in r] or rows
+        ok.sort(key=lambda r: r.get("minutes") or 1e9)
+        # lower median for even survivor counts: a failed attempt must not
+        # flip the published number to the slower (max) of two survivors
+        med = ok[(len(ok) - 1) // 2]
+        med["runs_min"] = [r.get("minutes") for r in ok]
+        med["note"] = (f"median of {len(ok)}/{repeat} successful "
+                       f"back-to-back runs; " + med["note"])
+        return med
     env = dict(os.environ, **env_over)
     print(f"=== {name}: {' '.join(argv[1:])}", flush=True)
     for attempt in (1, 2):
@@ -160,6 +176,7 @@ def run_row(name, argv, env_over, ckpt_path, note, timeout):
         "checkpoint": ckpt_path if ckpt_path and os.path.exists(ckpt_path)
         else ("missing!" if ckpt_path else "output/auto/checkpoint-*"),
         "wall_s_incl_startup": round(time.time() - t0, 1),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "note": note,
         "argv": argv[1:],
     }
@@ -190,11 +207,21 @@ def main() -> None:
         results = prior.get("rows") if "rows" in prior else {
             k: v for k, v in prior.items() if k != "meta"}
     wanted = [w.strip() for w in args.only.split(",")] if args.only else None
-    for name, argv, env_over, ckpt_path, note in RUNS:
+    fresh = set()
+    for name, argv, env_over, ckpt_path, note, *rest in RUNS:
         if wanted and not any(w in name for w in wanted):
             continue
         results[name] = run_row(name, argv, env_over, ckpt_path, note,
-                                args.timeout)
+                                args.timeout, repeat=rest[0] if rest else 1)
+        fresh.add(name)
+    # carried-over rows were measured under a (possibly different) earlier
+    # session/protocol — stamp them so the single meta.protocol block can't
+    # silently claim one methodology for rows it didn't produce
+    for name, row in results.items():
+        if isinstance(row, dict):
+            row.pop("carried_over", None)
+            if name not in fresh:
+                row["carried_over"] = True
 
     import jax
 
@@ -215,19 +242,32 @@ def main() -> None:
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2, ensure_ascii=False)
     print(f"\nwrote {args.out}")
-    print("\n| Strategy | min/epoch (post-compile) | probe steps/s | dev accuracy |")
-    print("|---|---|---|---|")
-    for name, row in results.items():
-        if "error" in row:
-            print(f"| {name} | FAILED: {row['error']} | — | — |")
-        else:
-            probe = (f"{row['probe_steps_per_sec']:.1f}"
-                     if row.get("probe_steps_per_sec") else "—")
-            mins = (f"{row['minutes']:.3f}"
-                    if row.get("minutes") is not None else "—")
-            acc = (f"{row['accuracy']:.4f}"
-                   if row.get("accuracy") is not None else "—")
-            print(f"| {name} | {mins} | {probe} | {acc} |")
+
+    def table(rows):
+        print("\n| Strategy | min/epoch (post-compile) | probe steps/s | dev accuracy |")
+        print("|---|---|---|---|")
+        for name, row in rows:
+            if "error" in row:
+                print(f"| {name} | FAILED: {row['error']} | — | — |")
+            else:
+                probe = (f"{row['probe_steps_per_sec']:.1f}"
+                         if row.get("probe_steps_per_sec") else "—")
+                mins = (f"{row['minutes']:.3f}"
+                        if row.get("minutes") is not None else "—")
+                acc = (f"{row['accuracy']:.4f}"
+                       if row.get("accuracy") is not None else "—")
+                stale = " (carried over)" if row.get("carried_over") else ""
+                print(f"| {name}{stale} | {mins} | {probe} | {acc} |")
+
+    # the CPU-mesh rows are execution evidence for multi-device-only paths
+    # (smaller models, data_limit) — never mix them into the TPU comparison
+    main_rows = [(n, r) for n, r in results.items() if "CPU" not in n]
+    ev_rows = [(n, r) for n, r in results.items() if "CPU" in n]
+    table(main_rows)
+    if ev_rows:
+        print("\nExecution evidence (CPU virtual mesh, reduced model/data — "
+              "not comparable to the TPU rows above):")
+        table(ev_rows)
 
 
 if __name__ == "__main__":

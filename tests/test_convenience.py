@@ -65,6 +65,32 @@ def test_accelerator_prepare_rescales_loader(corpus_path, ndev):
     assert isinstance(b["input_ids"], jax.Array)
 
 
+def test_accelerator_from_config_file(tmp_path, ndev):
+    """Machine config as a FILE (the reference's default_config.yaml,
+    ``/root/reference/default_config.yaml:1-15``): mesh shape and precision
+    come from the file, not the CLI."""
+    # HF-style JSON body (the reference's file IS json-formatted yaml)
+    p = tmp_path / "machine.json"
+    p.write_text('{"compute_environment": "LOCAL_MACHINE",'
+                 ' "distributed_type": "MULTI_GPU",'
+                 ' "mixed_precision": "bf16",'
+                 f' "num_processes": {ndev}}}')
+    acc = Accelerator.from_config(str(p))
+    assert acc.num_devices == ndev
+    assert acc.dtype == "bfloat16"
+    assert acc.args.dtype == "bfloat16"
+
+    # TPU-native extension: explicit mesh axes + YAML syntax
+    y = tmp_path / "machine.yaml"
+    y.write_text("mixed_precision: 'no'\n"
+                 "distributed_type: DEEPSPEED\n"
+                 "mesh_shape:\n  data: 2\n  model: 2\n")
+    acc = Accelerator.from_config(str(y))
+    assert dict(acc.mesh.shape) == {"data": 2, "model": 2}
+    assert acc.mode == "zero"
+    assert acc.dtype == "float32"
+
+
 def test_autotrainer_declarative_run(corpus_path, tmp_path):
     """Declarative config drives a managed run: eval cadence, checkpoint
     rotation, best-model reload (multi-gpu-transformers-cls.py:150-184)."""
@@ -203,3 +229,37 @@ def test_autotrainer_resume_from_checkpoint(corpus_path, tmp_path):
             output_dir=str(tmp_path / "p"),
             resume_from_checkpoint=str(tmp_path / "nope"),
             **common)).train()
+
+
+def test_autotrainer_resume_restores_best_tracking(corpus_path, tmp_path):
+    """trainer_state.json (HF's file of the same name) survives the crash:
+    a resumed run inherits the pre-crash best metric/dir, so a post-resume
+    run whose evals never beat it cannot ship a worse final model, and
+    rotation keeps protecting the pre-crash best dir."""
+    out = tmp_path / "bt"
+    common = dict(
+        model="bert-tiny", data_path=corpus_path, data_limit=400,
+        max_seq_len=16, eval_steps=4, save_steps=4, save_total_limit=None,
+        logging_steps=10 ** 6, num_train_epochs=1,
+        save_optimizer_state=True, load_best_model_at_end=True,
+    )
+    first = AutoTrainer(TrainerArgs(output_dir=str(out), **common))
+    # simulate a pre-crash life that already evaluated: a fat best metric
+    # no later eval on this corpus/model will beat
+    t = first._trainer
+    first.train_loader.set_epoch(0)
+    for i, batch in enumerate(first.train_loader):
+        t.state, _ = t.train_step(t.state, t.put(batch))
+        if i + 1 == 4:
+            break
+    first.best_metric = 0.999
+    first.best_ckpt = first._ckpt_dir(4)
+    first._save_checkpoint(4)
+    first._drain_writers()
+
+    resumed = AutoTrainer(TrainerArgs(
+        output_dir=str(out), resume_from_checkpoint="latest", **common))
+    resumed.train()
+    assert resumed.best_metric == 0.999          # inherited, not reset
+    assert resumed.best_ckpt == str(out / "checkpoint-4")
+    assert (out / "checkpoint-4").is_dir()       # rotation protected it
