@@ -4,11 +4,14 @@
 Prints ONE machine-parseable JSON line:
     {"metric": ..., "value": N, "unit": "min", "vs_baseline": N, ...}
 
-``value`` is wall-clock minutes for one full training epoch (288 steps at
-batch 32 on one chip; steps shrink as the data axis widens), the reference's
-own headline metric (``耗时：X分钟``, ``/root/reference/README.md:10-20``).
-``vs_baseline`` is the speedup against the published north-star wall-clock —
-2-GPU DDP+AMP, 0.6336 min (``README.md:16``) — so > 1.0 beats it.
+``value`` is the TOTAL training wall-clock in minutes — every epoch of the
+shipped recipe, the number a user actually waits for — with ``min_per_epoch``
+and ``minutes_to_target`` (first in-loop eval >= the reference's 0.57
+accuracy) alongside.  The reference's headline is its own total wall-clock
+(one epoch, ``耗时：X分钟``, ``/root/reference/README.md:10-20``);
+``vs_baseline`` is the speedup of this TOTAL against the published
+north-star — 2-GPU DDP+AMP, 0.6336 min (``README.md:16``) — so > 1.0 beats
+it outright, not per-epoch.
 
 Accuracy: the reference fine-tunes *pretrained* ``hfl/chinese-bert-wwm-ext``
 (dev acc ~0.57).  This environment has no egress, so the warm start is
@@ -81,27 +84,26 @@ def main() -> None:
     from pdnlp_tpu.train.run import build_parallel_trainer
     from pdnlp_tpu.utils.config import Args, parse_cli
 
-    # fuse_steps=4: K-step scan fusion is math-identical (dev loss/accuracy
-    # bit-equal to unfused) and trades ~6% device-step speed (scan-carried
-    # weights lose some XLA layout freedom: 33.4 vs 35.4 steps/s probed)
-    # for 4x fewer dispatches over the tunneled device transport — measured
-    # 0.167 vs 0.269 min/epoch on a slow-tunnel day, a wash (~0.16-0.17)
-    # on fast days.  --fuse_steps 1 restores per-step dispatch.
-    # Recipe (scripts/sweep_recipe*.py + sweep_sft.py sweeps; EMA/epoch
-    # grid in results/ema_sweep.json): 3 fine-tune epochs with linear
-    # warmup->decay at 3e-5, trained head restored (init_head), weight EMA
-    # at decay 0.99 (evaluated/checkpointed weights are the Polyak
-    # average), best-of checkpointing (the reference's own
-    # eval-every-50-steps keep-the-best ritual) — measured 0.5825 dev
-    # accuracy from the MLM+sft5 pretrain (swept optimum: 2ep@0.99 0.5813,
-    # 4ep 0.5787, decay 0.985/0.995/0.999 all lower; 0.5787 without EMA;
-    # the reference's pretrained checkpoint lands ~0.57, and 0.5763 under
-    # its exact 1-epoch constant-LR protocol).
+    # Recipe (r5: batch-64 sweep in results/recipe_b64_sweep.json; the r4
+    # b32 grid in results/ema_sweep.json): batch 64 amortizes the step's
+    # fixed AdamW+EMA cost (+36% examples/s, ~49% bf16 MFU — ablation +
+    # XProf profile in results/profile_r05.json), 3 fine-tune epochs with
+    # linear warmup->decay at 6e-5 (lr rescaled for the doubled batch;
+    # swept optimum: 6e-5 0.5813, 4.5e-5 0.58, 8e-5 0.5787, 3e-5 0.5725),
+    # trained head restored (init_head), weight EMA at decay 0.99
+    # (evaluated/checkpointed weights are the Polyak average), best-of
+    # checkpointing with eval every 48 steps — 48, not the reference's 50,
+    # so the cadence stays exact under fuse_steps=4 (trainer.py boundary
+    # note).  Measured 0.5813 dev accuracy in ~0.36 TOTAL minutes from the
+    # MLM+sft5 pretrain (2 epochs: 0.58 in ~0.24; the r4 b32 recipe's
+    # 0.5825 needed ~0.62 total).  fuse_steps=4 rides one dispatch per 4
+    # optimizer steps over the tunneled transport (multi_step docstring).
     args = parse_cli(base=Args(
         strategy="dp", dtype="bfloat16", fuse_steps=4,
+        train_batch_size=64, learning_rate=6e-5,
         epochs=3, lr_schedule="warmup_linear", ema_decay=0.99,
         sft_epochs=5,        # measured best; --sft_epochs 0 = MLM-only warm start
-        dev=True, eval_step=50,  # eval in-loop, keep best (reference protocol)
+        dev=True, eval_step=48,  # in-loop eval, keep best (reference ritual)
         log_every=10 ** 9,   # no per-step printing inside the timed loop
     ))
 
@@ -224,8 +226,17 @@ def main() -> None:
         sec_per_step = (_time.time() - t0) / 30
         del state, m
 
-        minutes = trainer.train(train_loader, dev_loader)
-        minutes /= args.epochs  # the reference metric is per-epoch
+        total_minutes = trainer.train(train_loader, dev_loader)
+        minutes = total_minutes / args.epochs
+        # time-to-accuracy from the in-loop eval history: minutes until the
+        # dev accuracy first reached the reference's 0.57, and until the
+        # run's best — the numbers per-epoch framing hides
+        to_target = next((e["minutes"] for e in trainer.eval_history
+                          if e["accuracy"] >= 0.57), None)
+        best_acc = max((e["accuracy"] for e in trainer.eval_history),
+                       default=0.0)
+        to_best = next((e["minutes"] for e in trainer.eval_history
+                        if e["accuracy"] >= best_acc), None)
         # trainer adopted the best-of-epoch params at the end of train()
         loss, acc = trainer.dev(dev_loader)
 
@@ -239,16 +250,23 @@ def main() -> None:
                              args.max_seq_len) / sec_per_step / peak
 
     print(json.dumps({
-        "metric": "wall_clock_min_per_epoch",
-        "value": round(minutes, 4),
+        "metric": "total_train_minutes",
+        "value": round(total_minutes, 4),
         "unit": "min",
-        "vs_baseline": round(NORTH_STAR_MIN / minutes, 4),
+        # TOTAL wall-clock vs the reference's total (its 1-epoch 0.6336):
+        # the honest time-to-accuracy comparison, not per-epoch
+        "vs_baseline": round(NORTH_STAR_MIN / total_minutes, 4),
         "baseline_min": NORTH_STAR_MIN,
         "single_gpu_baseline_min": SINGLE_GPU_MIN,
+        "min_per_epoch": round(minutes, 4),
+        "epochs": args.epochs,
+        "minutes_to_0.57": round(to_target, 4) if to_target else None,
+        "minutes_to_best": round(to_best, 4) if to_best else None,
         "dev_accuracy": round(acc, 4),
         "dev_loss": round(loss, 4),
         "steps_per_epoch": len(train_loader),
         "steps_per_sec": round(1.0 / sec_per_step, 2),
+        "batch_size": args.train_batch_size,
         "mfu_pct": round(mfu * 100, 1) if mfu is not None else None,
         "devices": jax.device_count(),
         "platform": jax.devices()[0].platform,

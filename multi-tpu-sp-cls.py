@@ -8,45 +8,16 @@ sequence inside each data shard; attention runs as ring attention over the
 ICI ``seq`` ring (``ops.ring``); the classification task stays byte-
 compatible with every other strategy.  On the short-sequence corpus it is a
 correctness/scale demonstration — its natural use is sequences that do not
-fit one device.
+fit one device (``results/longcontext.json`` for the measured rows).
+
+Multi-process: the spawn launcher runs this same path with the seq axis
+spanning OS processes (``multi-tpu-spawn-cls.py --mode sp``), pinned by
+``tests/test_spawn.py``.
 
     python multi-tpu-sp-cls.py --mesh_shape '{"data": 2, "seq": 4}'
 """
-import jax
-
-from pdnlp_tpu.data.corpus import LABELS
-from pdnlp_tpu.parallel import init_runtime, local_batch_mult, make_mesh
-from pdnlp_tpu.parallel.sp import SEQ, make_sp_batch, make_sp_eval_step, make_sp_train_step
-from pdnlp_tpu.train.setup import setup_data, setup_model
-from pdnlp_tpu.train.trainer import Trainer
+from pdnlp_tpu.train.run import run_sp
 from pdnlp_tpu.utils.config import Args, parse_cli
-from pdnlp_tpu.utils.logging import rank0_print
-from pdnlp_tpu.utils.metrics import classification_report
-
-
-def main(args: Args) -> float:
-    init_runtime(args)
-    shape = args.mesh_shape or {"data": 1, "seq": len(jax.devices())}
-    mesh = make_mesh(num_devices=args.num_devices, shape=shape)
-    train_loader, dev_loader, tok = setup_data(
-        args, num_shards=jax.process_count(), shard_id=jax.process_index(),
-        device_batch_mult=local_batch_mult(mesh))
-    cfg, tx, state = setup_model(args, tok.vocab_size,
-                                 total_steps=len(train_loader) * args.epochs)
-    example = next(iter(train_loader))
-    train_step = make_sp_train_step(cfg, tx, args, mesh)(example)
-    eval_step = make_sp_eval_step(cfg, args, mesh)(example)
-    trainer = Trainer(args, cfg, state, train_step, eval_step,
-                      put=make_sp_batch(mesh))
-    rank0_print(f"mesh: {dict(mesh.shape)}  ring axis: {SEQ} "
-                f"(local seq {args.max_seq_len // mesh.shape[SEQ]})  "
-                f"steps/epoch: {len(train_loader)}")
-    minutes = trainer.train(train_loader, dev_loader)
-    result = trainer.test(dev_loader)
-    rank0_print(f"test loss：{result['loss']:.6f} accuracy：{result['accuracy']:.4f}")
-    rank0_print(classification_report(result["y_true"], result["y_pred"], LABELS))
-    return minutes
-
 
 if __name__ == "__main__":
-    main(parse_cli(base=Args(strategy="sp", attn_dropout=0.0)))
+    run_sp(parse_cli(base=Args(strategy="sp", attn_dropout=0.0)))

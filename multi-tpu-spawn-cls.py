@@ -115,13 +115,18 @@ def main() -> int:
     # --mode picks the sharding the gang executes: dp (default, the
     # mp.spawn analog), zero (fully-sharded state spanning the process
     # boundary — the reference's actual DeepSpeed deployment shape,
-    # multi-gpu-deepspeed-cls.py:299-302), tp/ep, or pp (stage axis across
-    # processes).  Cross-process execution of zero and pp is pinned by
+    # multi-gpu-deepspeed-cls.py:299-302), tp/ep, pp (stage axis across
+    # processes), or sp (ring attention's seq axis across processes).
+    # Cross-process execution of zero/pp/tp/sp is pinned by
     # tests/test_spawn.py.
     if args.mode == "pp":
         from pdnlp_tpu.train.run import run_pipeline
 
         run_pipeline(args)
+    elif args.mode == "sp":
+        from pdnlp_tpu.train.run import run_sp
+
+        run_sp(args)
     else:
         run_parallel(args, mode=args.mode)
     return 0

@@ -335,44 +335,66 @@ def run_layers(layers: Params, cfg: BertConfig, x: jax.Array, *,
 
 def moe_mlp(x: jax.Array, lp: Params, cfg: BertConfig, *, dtype=jnp.float32,
             mask: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
-    """Top-k gated mixture-of-experts MLP (dense dispatch), one layer.
+    """Top-k gated mixture-of-experts MLP, one layer.
 
-    Every device computes its *local* experts' FFN for all tokens and the
-    gate-weighted combine contracts the expert dim — under the "ep"
-    sharding mode (expert dim split over an ``expert`` mesh axis) XLA turns
-    that contraction into the expert all-reduce, no hand-written all-to-all
-    (the GSPMD MoE formulation; at this scale dense dispatch keeps the MXU
-    busy where sparse scatter would fragment it).
+    Routing (shared by both dispatches): fp32 softmax gate, top-k experts
+    per token, renormalized combine weights, Switch-style load-balancing
+    aux loss E * sum_e(token_frac_e * prob_frac_e) (caller accumulates;
+    1.0 = perfectly balanced).  ``mask`` ([B, S] {0,1}) keeps padding out
+    of the balancing statistics — and, under grouped dispatch, out of the
+    capacity slots — without it, padding (identical embeddings routed
+    identically) dilutes the pressure on real tokens by the padding
+    fraction.
 
-    Returns ``(output [B,S,H], aux)`` where ``aux`` is the Switch-style
-    load-balancing loss E * sum_e(token_frac_e * prob_frac_e) for THIS
-    layer (caller accumulates; 1.0 = perfectly balanced).  ``mask``
-    ([B, S] {0,1}) restricts the balancing statistics to real tokens —
-    without it, padding (identical embeddings routed identically) dilutes
-    the pressure on real tokens by the padding fraction.
+    ``cfg.moe_dispatch`` picks the compute:
+
+    - ``"grouped"`` (default): capacity-based dispatch — gather each
+      expert's tokens into a static ``[E, capacity, H]`` buffer, run the
+      expert FFNs as batched matmuls, scatter-combine.  FFN cost scales
+      with ``k * capacity_factor``, not ``E`` (the property that makes
+      expert counts beyond a handful affordable); tokens over a full
+      expert's capacity skip that expert (the residual connection carries
+      them — standard Switch/GShard semantics).
+    - ``"dense"``: every expert computes every token and the gate-weighted
+      combine contracts the expert dim (the GSPMD formulation; exact — no
+      capacity drops — and the parity oracle for the grouped path, but
+      O(E) FLOPs: measured 11.7 vs 35.5 dense-model steps/s at E=4 on
+      v5e, r4 matrix).
+
+    Under the "ep" sharding mode the expert dim of the weights (and of the
+    grouped path's ``[E, capacity, H]`` buffers) is split over an "expert"
+    mesh axis; XLA inserts the combine all-reduce from the shardings.
+
+    Returns ``(output [B,S,H], aux)``.
     """
     E = lp["gate"]["kernel"].shape[-1]
     gate_logits = (x @ lp["gate"]["kernel"].astype(dtype)).astype(jnp.float32)
     probs = jax.nn.softmax(gate_logits)                      # [B,S,E] fp32
     k = min(cfg.moe_top_k, E)
     top_p, top_idx = jax.lax.top_k(probs, k)                 # [B,S,k]
-    # scatter renormalized top-k probs back to [B,S,E]
+    # renormalized top-k combine weights
     renorm = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
-    onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)   # [B,S,k,E]
-    combine = jnp.einsum("bske,bsk->bse", onehot, renorm)    # [B,S,E]
 
-    up_k, up_b = lp["up"]["kernel"], lp["up"]["bias"]        # [E,H,I],[E,I]
-    down_k, down_b = lp["down"]["kernel"], lp["down"]["bias"]
-    h = jnp.einsum("bsh,ehi->ebsi", x, up_k.astype(dtype)) \
-        + up_b.astype(dtype)[:, None, None, :]
-    h = jax.nn.gelu(h, approximate=False)
-    y = jnp.einsum("ebsi,eih->ebsh", h, down_k.astype(dtype)) \
-        + down_b.astype(dtype)[:, None, None, :]
-    out = jnp.einsum("ebsh,bse->bsh", y, combine.astype(dtype))
+    if cfg.moe_dispatch not in ("grouped", "dense"):
+        raise ValueError(
+            f"moe_dispatch={cfg.moe_dispatch!r} — use 'grouped' or 'dense'; "
+            "a silent fallback would quietly benchmark the O(E) path")
+    if cfg.moe_dispatch == "grouped":
+        out = _moe_grouped(x, lp, top_idx, renorm, cfg, dtype=dtype,
+                           mask=mask)
+    else:
+        onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [B,S,k,E]
+        combine = jnp.einsum("bske,bsk->bse", onehot, renorm)   # [B,S,E]
+        up_k, up_b = lp["up"]["kernel"], lp["up"]["bias"]    # [E,H,I],[E,I]
+        down_k, down_b = lp["down"]["kernel"], lp["down"]["bias"]
+        h = jnp.einsum("bsh,ehi->ebsi", x, up_k.astype(dtype)) \
+            + up_b.astype(dtype)[:, None, None, :]
+        h = jax.nn.gelu(h, approximate=False)
+        y = jnp.einsum("ebsi,eih->ebsh", h, down_k.astype(dtype)) \
+            + down_b.astype(dtype)[:, None, None, :]
+        out = jnp.einsum("ebsh,bse->bsh", y, combine.astype(dtype))
 
-    # Switch load-balancing: fraction of top-1 tokens per expert x mean
-    # gate prob per expert, scaled by E (1.0 when uniform); masked means
-    # keep padding out of the statistics
+    # Switch load-balancing statistics (masked means: see docstring)
     top1 = jax.nn.one_hot(top_idx[..., 0], E, dtype=jnp.float32)
     if mask is not None:
         m = mask.astype(jnp.float32).reshape(-1)[:, None]     # [BS, 1]
@@ -384,6 +406,60 @@ def moe_mlp(x: jax.Array, lp: Params, cfg: BertConfig, *, dtype=jnp.float32,
         prob_frac = probs.reshape(-1, E).mean(0)
     aux = E * jnp.sum(token_frac * prob_frac)
     return out, aux
+
+
+def _moe_grouped(x: jax.Array, lp: Params, top_idx: jax.Array,
+                 renorm: jax.Array, cfg: BertConfig, *, dtype,
+                 mask: Optional[jax.Array]) -> jax.Array:
+    """Capacity-based expert dispatch: static shapes end to end.
+
+    Slot assignment is the GShard position-in-expert cumsum: assignments
+    are ranked token-major (earlier tokens win capacity), each keeps its
+    slot iff ``position < capacity``.  Dropped assignments simply don't
+    contribute (the caller's residual carries the token).  Padding tokens
+    (``mask`` 0) never occupy slots — on this corpus ~80% of positions are
+    padding, which would otherwise eat most of the capacity real tokens
+    need.  With ``capacity >= tokens`` nothing can drop and the result
+    equals dense dispatch up to summation order (pinned in
+    ``tests/test_moe.py``)."""
+    import math
+
+    B, S, H = x.shape
+    T = B * S
+    E = lp["up"]["kernel"].shape[0]
+    k = top_idx.shape[-1]
+    C = int(math.ceil(cfg.moe_capacity_factor * k * T / E))
+    C = min(C, T)  # one slot per token per expert is the most ever needed
+
+    x2 = x.reshape(T, H)
+    flat_e = top_idx.reshape(-1)                      # [T*k], token-major
+    w_flat = renorm.reshape(-1)                       # [T*k] fp32
+    keep = jnp.ones((T * k,), bool)
+    if mask is not None:
+        keep = jnp.repeat(mask.reshape(-1).astype(bool), k)
+    # position-in-expert: how many kept assignments to my expert precede me
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32) * keep[:, None]
+    pos = jnp.cumsum(onehot, axis=0) - onehot         # [T*k, E]
+    mypos = jnp.take_along_axis(pos, flat_e[:, None], 1)[:, 0]
+    keep = keep & (mypos < C)
+    # slot tables: [E, C] -> source token (sentinel T = zero row) + weight
+    e_idx = jnp.where(keep, flat_e, E)                # E = out of bounds
+    tok = jnp.arange(T * k, dtype=jnp.int32) // k
+    slot_tok = jnp.full((E, C), T, jnp.int32).at[e_idx, mypos].set(
+        tok, mode="drop")
+    slot_w = jnp.zeros((E, C), jnp.float32).at[e_idx, mypos].set(
+        w_flat, mode="drop")
+
+    xe = jnp.concatenate([x2, jnp.zeros((1, H), x2.dtype)])[slot_tok]
+    h = jnp.einsum("ech,ehi->eci", xe, lp["up"]["kernel"].astype(dtype)) \
+        + lp["up"]["bias"].astype(dtype)[:, None, :]
+    h = jax.nn.gelu(h, approximate=False)
+    y = jnp.einsum("eci,eih->ech", h, lp["down"]["kernel"].astype(dtype)) \
+        + lp["down"]["bias"].astype(dtype)[:, None, :]
+    y = y * slot_w[..., None].astype(dtype)           # sentinel slots -> 0
+    out = jnp.zeros((T + 1, H), dtype).at[slot_tok.reshape(-1)].add(
+        y.reshape(E * C, H), mode="drop")[:T]
+    return out.reshape(B, S, H)
 
 
 def init_mlm_head(key: jax.Array, cfg: BertConfig) -> Params:

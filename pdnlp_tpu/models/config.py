@@ -31,6 +31,14 @@ class BertConfig:
     moe_experts: int = 0          # experts per layer's MLP
     moe_top_k: int = 2            # experts combined per token
     moe_aux_coef: float = 0.01    # Switch-style load-balancing loss weight
+    moe_dispatch: str = "grouped" # "grouped": capacity-based gather +
+                                  # per-expert matmuls, O(k*capacity) FFN
+                                  # cost; "dense": every expert computes
+                                  # every token, O(E) — exact, no drops,
+                                  # the small-E fallback and parity oracle
+    moe_capacity_factor: float = 1.25  # slots per expert =
+                                  # ceil(cf * k * tokens / E); tokens over
+                                  # capacity fall back to the residual path
 
     @property
     def head_dim(self) -> int:
@@ -84,3 +92,16 @@ def get_config(name: str, vocab_size: Optional[int] = None,
 
 def available_models():
     return sorted(_REGISTRY)
+
+
+def args_overrides(args) -> dict:
+    """Config overrides an ``Args`` carries when explicitly set (None =
+    keep the registry default) — shared by every ``get_config(args.model)``
+    call site so CLI knobs can't silently apply on one path only."""
+    kw = {}
+    for f in ("moe_dispatch", "moe_capacity_factor", "moe_top_k",
+              "moe_experts"):
+        v = getattr(args, f, None)
+        if v is not None:
+            kw[f] = v
+    return kw

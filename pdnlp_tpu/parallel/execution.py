@@ -25,6 +25,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pdnlp_tpu.models import BertConfig, bert, get_config
+from pdnlp_tpu.models.config import args_overrides
 from pdnlp_tpu.parallel import collectives
 from pdnlp_tpu.parallel.mesh import DATA_AXIS
 from pdnlp_tpu.parallel.sharding import batch_sharding, replicated, state_shardings
@@ -47,7 +48,8 @@ def setup_sharded_model(args, vocab_size: int, mesh: Mesh, mode: str = "dp",
     from pdnlp_tpu.utils.seeding import train_key
 
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
-                     dropout=args.dropout, attn_dropout=args.attn_dropout)
+                     dropout=args.dropout, attn_dropout=args.attn_dropout,
+                     **args_overrides(args))
     if mode == "tp":
         from pdnlp_tpu.parallel.sharding import MODEL_AXIS
 

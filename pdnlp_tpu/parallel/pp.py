@@ -76,6 +76,7 @@ def setup_pp_model(args, vocab_size: int, mesh: Mesh, total_steps: int = None
     """(cfg, tx, state, shardings) with the layer stack sharded over
     ``stage`` from init — the pipeline twin of ``setup_sharded_model``."""
     from pdnlp_tpu.models import get_config
+    from pdnlp_tpu.models.config import args_overrides
     from pdnlp_tpu.train.optim import build_optimizer, make_schedule
     from pdnlp_tpu.utils.seeding import set_seed, train_key
 
@@ -89,7 +90,8 @@ def setup_pp_model(args, vocab_size: int, mesh: Mesh, total_steps: int = None
                          "EMA tree")
     n_stages = mesh.shape[STAGE]
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
-                     dropout=args.dropout, attn_dropout=args.attn_dropout)
+                     dropout=args.dropout, attn_dropout=args.attn_dropout,
+                     **args_overrides(args))
     if cfg.num_layers % n_stages:
         raise ValueError(f"pipeline degree {n_stages} must divide num_layers "
                          f"({cfg.num_layers}) — stages hold contiguous "

@@ -31,14 +31,30 @@ DATA, SEQ = "data", "seq"
 
 def make_sp_batch(mesh: Mesh) -> Callable[[Dict], Dict[str, jax.Array]]:
     """Batch placement: token arrays [B, S] shard over (data, seq); label
-    vectors [B] shard over data only."""
+    vectors [B] shard over data only.
+
+    When the ``seq`` axis spans OS processes (spawn ``--mode sp``), each
+    process holds the full [B, S] host batch (the data axis is then
+    process-local — ``run.build_sp_trainer`` feeds accordingly) and
+    ``make_array_from_callback`` hands every device exactly its sequence
+    slice; ``make_array_from_process_local_data`` would instead interpret
+    the full batch as this process's *shard* and mis-assemble."""
+    from pdnlp_tpu.parallel.mesh import local_data_extent
+
+    seq_spans_processes = (jax.process_count() > 1
+                           and SEQ in mesh.shape
+                           and local_data_extent(mesh, SEQ)[0] > 1)
 
     def put(batch: Dict) -> Dict[str, jax.Array]:
         out = {}
         for key, val in batch.items():
             spec = P(DATA, SEQ) if val.ndim == 2 else P(DATA)
-            out[key] = jax.make_array_from_process_local_data(
-                NamedSharding(mesh, spec), val)
+            sh = NamedSharding(mesh, spec)
+            if seq_spans_processes:
+                out[key] = jax.make_array_from_callback(
+                    val.shape, sh, lambda idx, v=val: v[idx])
+            else:
+                out[key] = jax.make_array_from_process_local_data(sh, val)
         return out
 
     return put

@@ -35,6 +35,7 @@ from pdnlp_tpu.data.corpus import load_data, split_data
 from pdnlp_tpu.data.packing import pack_texts, segment_bias
 from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, get_or_build_vocab
 from pdnlp_tpu.models import bert, get_config
+from pdnlp_tpu.models.config import args_overrides
 from pdnlp_tpu.parallel import make_global_batch, make_mesh
 from pdnlp_tpu.parallel.sharding import batch_sharding, replicated
 from pdnlp_tpu.train import checkpoint as ckpt
@@ -247,7 +248,8 @@ def run_pretrain(args) -> str:
 
     cfg = get_config(args.model, vocab_size=tok.vocab_size,
                      num_labels=args.num_labels, dropout=args.dropout,
-                     attn_dropout=args.attn_dropout)
+                     attn_dropout=args.attn_dropout,
+                     **args_overrides(args))
     root = jax.random.PRNGKey(args.seed)
     # 3-way split kept although slot 3 is unused (the dropout stream now
     # comes from train_key): changing the split would change k_init/k_head

@@ -116,6 +116,57 @@ def run_parallel(args: Args, **strategy) -> float:
     return minutes
 
 
+def build_sp_trainer(args: Args, mesh=None):
+    """(trainer, train_loader, dev_loader) for the sequence-parallel (ring
+    attention) path — multi-process aware: on a mesh whose ``seq`` axis
+    spans processes, the data axis is process-local, every process feeds the
+    full global batch, and ``make_sp_batch`` hands each device its sequence
+    slice (the ring's ``ppermute`` then crosses the process boundary)."""
+    from pdnlp_tpu.parallel import init_runtime, make_mesh
+    from pdnlp_tpu.parallel.mesh import local_data_extent
+    from pdnlp_tpu.parallel.sp import (
+        SEQ, make_sp_batch, make_sp_eval_step, make_sp_train_step,
+    )
+    from pdnlp_tpu.train.setup import setup_model
+
+    if mesh is None:
+        init_runtime(args)
+        shape = args.mesh_shape or {"data": 1, SEQ: len(jax.devices())}
+        mesh = make_mesh(num_devices=args.num_devices, shape=shape)
+    num_shards, shard_id, mult = local_data_extent(mesh)
+    if jax.process_count() > 1 and num_shards > 1 \
+            and local_data_extent(mesh, SEQ)[0] > 1:
+        raise ValueError(
+            "a mesh whose data AND seq axes both span processes needs "
+            "per-process partial batches with seq slicing — order the mesh "
+            "so one of the two axes stays process-local")
+    train_loader, dev_loader, tok = setup_data(
+        args, num_shards=num_shards, shard_id=shard_id,
+        device_batch_mult=mult)
+    cfg, tx, state = setup_model(args, tok.vocab_size,
+                                 total_steps=len(train_loader) * args.epochs)
+    example = next(iter(train_loader))
+    train_step = make_sp_train_step(cfg, tx, args, mesh)(example)
+    eval_step = make_sp_eval_step(cfg, args, mesh)(example)
+    trainer = Trainer(args, cfg, state, train_step, eval_step,
+                      put=make_sp_batch(mesh))
+    rank0_print(f"mesh: {dict(mesh.shape)}  process "
+                f"{jax.process_index()}/{jax.process_count()}  ring axis: "
+                f"{SEQ} (local seq {args.max_seq_len // mesh.shape[SEQ]})  "
+                f"steps/epoch: {len(train_loader)}")
+    return trainer, train_loader, dev_loader
+
+
+def run_sp(args: Args) -> float:
+    """Train + test on the sequence-parallel path; returns wall-clock min."""
+    trainer, train_loader, dev_loader = build_sp_trainer(args)
+    minutes = trainer.train(train_loader, dev_loader)
+    result = trainer.test(dev_loader)
+    rank0_print(f"test loss：{result['loss']:.6f} accuracy：{result['accuracy']:.4f}")
+    rank0_print(classification_report(result["y_true"], result["y_pred"], LABELS))
+    return minutes
+
+
 def build_pipeline_trainer(args: Args, mesh=None):
     """(trainer, train_loader, dev_loader) for the pipeline (GPipe) path —
     the ``pp`` twin of ``build_parallel_trainer``, multi-process aware: on a

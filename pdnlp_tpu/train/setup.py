@@ -15,6 +15,7 @@ from pdnlp_tpu.data import Collator, DataLoader, WordPieceTokenizer, load_data, 
 from pdnlp_tpu.data.sampler import DistributedShardSampler
 from pdnlp_tpu.data.tokenizer import get_or_build_vocab
 from pdnlp_tpu.models import bert, get_config
+from pdnlp_tpu.models.config import args_overrides
 from pdnlp_tpu.train.optim import build_optimizer
 from pdnlp_tpu.utils.seeding import set_seed
 
@@ -75,7 +76,8 @@ def setup_model(args, vocab_size: int, total_steps: int = None):
                          "not this entrypoint — it would be silently ignored "
                          "here")
     cfg = get_config(args.model, vocab_size=vocab_size, num_labels=args.num_labels,
-                     dropout=args.dropout, attn_dropout=args.attn_dropout)
+                     dropout=args.dropout, attn_dropout=args.attn_dropout,
+                     **args_overrides(args))
     root = set_seed(args.seed)
     init_key, _ = jax.random.split(root)
     train_rng = train_key(args.seed, getattr(args, "rng_impl", "rbg"))

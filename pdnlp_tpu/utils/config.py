@@ -113,6 +113,14 @@ class Args:
                                                   # strategies), "seq" (sp),
                                                   # and "model" (tp), e.g.
                                                   # {"data": 2, "model": 4}
+    moe_dispatch: Optional[str] = None            # grouped|dense (None =
+                                                  # model-config default;
+                                                  # models/config.py)
+    moe_capacity_factor: Optional[float] = None   # grouped-dispatch slots
+                                                  # per expert multiplier
+    moe_top_k: Optional[int] = None               # experts combined/token
+    moe_experts: Optional[int] = None             # expert count override
+                                                  # (scaling experiments)
     accel_config: Optional[str] = None            # Accelerator machine-config
                                                   # file (JSON/YAML, the
                                                   # default_config.yaml

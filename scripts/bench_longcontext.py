@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Long-context TRAINING measurements (VERDICT r4 item 4).
+
+Runs real fused train steps on ``bert-base-long`` (2048-position table) at
+seq 1024/2048 on the chip — remat on, bf16, XLA vs the pallas flash kernel —
+and records steps/s, tokens/s, and peak HBM.  This is the full-step number
+the op-level flash table (README) could not give: the crossover claim for
+training comes from here.
+
+Writes/merges ``results/longcontext.json``.
+
+    python scripts/bench_longcontext.py [name-substring ...]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PATH = os.path.join(REPO, "results", "longcontext.json")
+
+CODE = r"""
+import json, sys, time
+spec = json.loads(sys.argv[1])
+import jax, jax.numpy as jnp
+jax.config.update('jax_compilation_cache_dir', 'output/xla_cache')
+from pdnlp_tpu.train.run import build_parallel_trainer
+from pdnlp_tpu.utils.config import Args
+args = Args(**spec['args'])
+tr, tl, _ = build_parallel_trainer(args, mode='dp')
+batch = tr.put(next(iter(tl)))
+state = jax.tree_util.tree_map(jnp.copy, tr.state)
+for _ in range(3):
+    state, m = tr.train_step(state, batch)
+float(jax.device_get(m['loss']))
+n = spec.get('steps', 20)
+t0 = time.time()
+for _ in range(n):
+    state, m = tr.train_step(state, batch)
+float(jax.device_get(m['loss']))
+dt = time.time() - t0
+stats = jax.devices()[0].memory_stats() or {}
+print(json.dumps({
+    'steps_per_sec': round(n / dt, 3),
+    'tokens_per_sec': round(n / dt * args.train_batch_size * args.max_seq_len),
+    'peak_hbm_gb': round(stats.get('peak_bytes_in_use', 0) / 2**30, 2),
+    'loss': round(float(jax.device_get(m['loss'])), 4),
+}))
+"""
+
+
+def run(name, seq, batch, attn, remat=True, extra=None):
+    args = dict(strategy="dp", model="bert-base-long", dtype="bfloat16",
+                max_seq_len=seq, train_batch_size=batch, dev_batch_size=batch,
+                remat=remat, attention_impl=attn, log_every=10 ** 9,
+                data_limit=2000)
+    args.update(extra or {})
+    out = subprocess.run(
+        [sys.executable, "-c", CODE,
+         json.dumps({"args": args, "steps": 20})],
+        capture_output=True, text=True, cwd=REPO)
+    if out.returncode != 0:
+        print(f"{name}: FAILED\n{out.stderr[-2500:]}", file=sys.stderr)
+        return {"error": out.stderr.strip().splitlines()[-1][:300]
+                if out.stderr.strip() else "unknown"}
+    r = json.loads(out.stdout.strip().splitlines()[-1])
+    r["config"] = {"seq": seq, "batch": batch, "attention_impl": attn,
+                   "remat": remat, **(extra or {})}
+    print(f"{name}: {r['steps_per_sec']} steps/s, {r['tokens_per_sec']} tok/s,"
+          f" peak {r['peak_hbm_gb']} GB", file=sys.stderr)
+    return r
+
+
+def main():
+    res = json.load(open(PATH)) if os.path.exists(PATH) else {}
+    res.setdefault("meta", {
+        "model": "bert-base-long (2048-position table, models/config.py)",
+        "protocol": "20 re-fed fused train steps (fwd+bwd+AdamW) after 3 "
+                    "warmup, bf16, remat on, single chip; tokens/s = "
+                    "steps/s * batch * seq",
+    })
+    res.setdefault("rows", {})
+    grid = {
+        "seq512_b16_xla": (512, 16, "xla"),
+        "seq512_b16_flash": (512, 16, "pallas"),
+        "seq1024_b8_xla": (1024, 8, "xla"),
+        "seq1024_b8_flash": (1024, 8, "pallas"),
+        "seq2048_b4_xla": (2048, 4, "xla"),
+        "seq2048_b4_flash": (2048, 4, "pallas"),
+        "seq2048_b4_xla_noremat": (2048, 4, "xla", False),
+    }
+    only = sys.argv[1:]
+    for name, spec in grid.items():
+        if only and not any(o in name for o in only):
+            continue
+        if name in res["rows"] and "error" not in res["rows"][name]:
+            continue
+        res["rows"][name] = run(name, *spec)
+        json.dump(res, open(PATH, "w"), indent=2)
+
+    # the sequence-parallel path at 1024: the sp entrypoint itself (ring
+    # attention inside shard_map; seq axis 1 on the one-chip image — the
+    # ring's multi-shard parity is pinned by tests/test_sp.py and the
+    # cross-process spawn test), probe = the controlled metric
+    name = "sp_seq1024_b8_ring"
+    if (not only or any(o in name for o in only)) and (
+            name not in res["rows"] or "error" in res["rows"][name]):
+        import re
+
+        argv = [sys.executable, "multi-tpu-sp-cls.py", "--model",
+                "bert-base-long", "--max_seq_len", "1024",
+                "--train_batch_size", "8", "--dev_batch_size", "8",
+                "--dtype", "bfloat16", "--attn_dropout", "0.0",
+                "--data_limit", "2000", "--remat", "true",
+                "--warmup_compile", "true", "--probe_steps", "20",
+                "--log_every", "1000000"]
+        out = subprocess.run(argv, capture_output=True, text=True, cwd=REPO)
+        text = out.stdout + out.stderr
+        probe = re.findall(r"probe steps/s：([\d.]+)", text)
+        mins = re.findall(r"耗时：([\d.]+)分钟", text)
+        row = ({"steps_per_sec": float(probe[-1]),
+                "tokens_per_sec": round(float(probe[-1]) * 8 * 1024),
+                "epoch_minutes": float(mins[-1]) if mins else None,
+                "config": {"seq": 1024, "batch": 8, "impl": "ring(shard_map)",
+                           "remat": True, "argv": argv[1:]}}
+               if out.returncode == 0 and probe else
+               {"error": text.strip().splitlines()[-1][:300]})
+        res["rows"][name] = row
+        print(f"{name}: {row}", file=sys.stderr)
+        try:
+            import jax
+
+            res["meta"]["device"] = jax.devices()[0].device_kind
+        except Exception:
+            pass
+        json.dump(res, open(PATH, "w"), indent=2)
+    print(json.dumps(res["rows"], indent=2))
+
+
+if __name__ == "__main__":
+    main()

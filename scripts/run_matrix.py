@@ -133,7 +133,12 @@ def run_row(name, argv, env_over, ckpt_path, note, timeout, repeat=1):
     if repeat > 1:
         rows = [run_row(name, argv, env_over, ckpt_path, note, timeout)
                 for _ in range(repeat)]
-        ok = [r for r in rows if "error" not in r] or rows
+        ok = [r for r in rows if "error" not in r]
+        if not ok:  # all attempts failed: ship an honestly-labeled error row
+            err = rows[0]
+            err["note"] = (f"all {repeat} back-to-back attempts failed; "
+                           + err.get("note", note))
+            return err
         ok.sort(key=lambda r: r.get("minutes") or 1e9)
         # lower median for even survivor counts: a failed attempt must not
         # flip the published number to the slower (max) of two survivors

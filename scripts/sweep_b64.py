@@ -69,6 +69,17 @@ def main():
         for ema in (0.99, 0.995):
             grid[f"b64_lr{lr:g}_ema{ema:g}_3ep"] = dict(
                 learning_rate=lr, ema_decay=ema, epochs=3)
+    # refinement round: lr 6e-5 won the first grid at 0.5813/0.36min —
+    # probe above it and around the epoch count
+    for lr in (8e-5, 1e-4):
+        grid[f"b64_lr{lr:g}_ema0.99_3ep"] = dict(
+            learning_rate=lr, ema_decay=0.99, epochs=3)
+    grid["b64_lr6e-05_ema0.99_2ep"] = dict(
+        learning_rate=6e-5, ema_decay=0.99, epochs=2)
+    grid["b64_lr8e-05_ema0.99_2ep"] = dict(
+        learning_rate=8e-5, ema_decay=0.99, epochs=2)
+    grid["b64_lr6e-05_ema0.99_4ep"] = dict(
+        learning_rate=6e-5, ema_decay=0.99, epochs=4)
     only = sys.argv[1:]
     for name, kw in grid.items():
         if only and not any(o in name for o in only):
@@ -76,7 +87,9 @@ def main():
         if name in res["runs"] and res["runs"][name]:
             continue
         res["runs"][name] = run(name, **kw)
-        json.dump(res, open(PATH, "w"), indent=2)
+        tmp = PATH + ".tmp"  # atomic: an interrupt must not eat prior runs
+        json.dump(res, open(tmp, "w"), indent=2)
+        os.replace(tmp, PATH)
     best = max((r for r in res["runs"].values() if r),
                key=lambda r: r["best_accuracy"], default=None)
     print(json.dumps({"best": best}, indent=2))

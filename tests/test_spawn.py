@@ -307,3 +307,77 @@ def test_spawn_tp_across_processes(tmp_path):
     flat_b = np.concatenate([np.asarray(l).ravel() for l in
                              jax.tree_util.tree_leaves(trainer.state["params"])])
     np.testing.assert_allclose(flat_a, flat_b, rtol=1e-3, atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def spawn_sp_run(tmp_path_factory):
+    """``--mode sp`` across 2 real processes x 1 CPU device each: a
+    ``{"data": 1, "seq": 2}`` mesh whose sequence axis IS the process
+    boundary — ring attention's ``ppermute`` KV rotation crosses processes
+    every layer."""
+    out = tmp_path_factory.mktemp("spawn_sp")
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PDNLP_SPAWN_PORT="12383",  # own rendezvous port per gang fixture
+    )
+    env.pop("COORDINATOR_ADDRESS", None)
+    env.pop("PROCESS_ID", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "multi-tpu-spawn-cls.py"),
+         "--num_processes", "2", "--mode", "sp",
+         "--mesh_shape", '{"data": 1, "seq": 2}',
+         "--ckpt_name", "sp-spawn.msgpack",
+         "--output_dir", str(out), *COMMON_ARGS],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    return proc, out
+
+
+def test_spawn_sp_executes_across_processes(spawn_sp_run):
+    proc, out = spawn_sp_run
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "ring axis: seq (local seq 16)" in proc.stdout
+    assert "process 0/2" in proc.stdout
+    assert (out / "sp-spawn.msgpack").exists()
+
+
+def test_spawn_sp_matches_single_process(spawn_sp_run, ndev):
+    """The cross-process ring must reproduce an in-process run of the
+    identical {"data": 1, "seq": 2} mesh — same global batch, same seeded
+    streams; the only difference is WHERE the ring's ppermute hops land."""
+    proc, out = spawn_sp_run
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    from pdnlp_tpu.train.run import build_sp_trainer
+    from pdnlp_tpu.train import checkpoint as ckpt
+    from pdnlp_tpu.utils.config import Args
+
+    args = Args(strategy="sp-spawn-ref", model="bert-tiny", data_limit=600,
+                max_seq_len=32, train_batch_size=4, dtype="float32",
+                dropout=0.0, attn_dropout=0.0, epochs=1,
+                mesh_shape={"data": 1, "seq": 2}, num_devices=2,
+                output_dir=str(out), log_every=1)
+    trainer, train_loader, _ = build_sp_trainer(args)
+    single_losses = []
+    for batch in train_loader:
+        trainer.state, m = trainer.train_step(trainer.state, trainer.put(batch))
+        single_losses.append(float(m["loss"]))
+
+    spawn_losses = [float(x) for x in
+                    re.findall(r"loss：([0-9.]+)", proc.stdout)]
+    n = min(len(spawn_losses), len(single_losses))
+    assert n >= 5, f"too few logged losses: {proc.stdout[-2000:]}"
+    np.testing.assert_allclose(spawn_losses[:n], single_losses[:n],
+                               rtol=2e-4, atol=2e-5)
+
+    import jax
+
+    restored = ckpt.load_params(str(out / "sp-spawn.msgpack"),
+                                trainer.state["params"])
+    flat_a = np.concatenate([np.asarray(l).ravel() for l in
+                             jax.tree_util.tree_leaves(restored)])
+    flat_b = np.concatenate([np.asarray(l).ravel() for l in
+                             jax.tree_util.tree_leaves(trainer.state["params"])])
+    np.testing.assert_allclose(flat_a, flat_b, rtol=1e-3, atol=1e-5)
