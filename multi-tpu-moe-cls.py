@@ -32,7 +32,11 @@ if __name__ == "__main__":
         n = len(jax.devices())
         # expert degree can't exceed the expert count; spare devices go to
         # the data axis (1 chip -> {"data": 1, "expert": 1}, degenerate ok)
-        experts = get_config(args.model).moe_experts
+        from pdnlp_tpu.models.config import args_overrides
+
+        # honor --moe_experts here too: the mesh's expert axis must divide
+        # the count the model is actually built with, not the registry's
+        experts = get_config(args.model, **args_overrides(args)).moe_experts
         e = next(d for d in range(min(n, experts), 0, -1)
                  if experts % d == 0 and n % d == 0)
         args = args.replace(mesh_shape={"data": n // e, "expert": e})

@@ -11,7 +11,11 @@ plain ``psum`` of gradients over ``seq`` counts head parameters once.
 
 This capability has no reference twin (``SURVEY.md`` §5: long-context
 "absent"); it exists so the framework scales past single-device sequence
-lengths, and is exercised by the multichip dryrun and the CPU-mesh tests.
+lengths.  Measured on the chip at the lengths it exists for: 7.0 steps/s
+training ``bert-base-long`` at seq 1024 (57k tokens/s,
+``results/longcontext.json``); multi-shard parity is pinned by
+``tests/test_sp.py``, the multichip dryrun, and a seq axis spanning two
+real OS processes in ``tests/test_spawn.py``.
 """
 from __future__ import annotations
 

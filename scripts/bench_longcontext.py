@@ -52,10 +52,14 @@ print(json.dumps({
 
 
 def run(name, seq, batch, attn, remat=True, extra=None):
+    # attn_dropout=0 on EVERY row: probability dropout forces the XLA
+    # attention path (ops/attention.py), so a "pallas" row with the default
+    # 0.1 would silently measure XLA — and the xla/flash comparison must
+    # train the same model anyway
     args = dict(strategy="dp", model="bert-base-long", dtype="bfloat16",
                 max_seq_len=seq, train_batch_size=batch, dev_batch_size=batch,
                 remat=remat, attention_impl=attn, log_every=10 ** 9,
-                data_limit=2000)
+                data_limit=2000, attn_dropout=0.0)
     args.update(extra or {})
     out = subprocess.run(
         [sys.executable, "-c", CODE,
@@ -71,6 +75,14 @@ def run(name, seq, batch, attn, remat=True, extra=None):
     print(f"{name}: {r['steps_per_sec']} steps/s, {r['tokens_per_sec']} tok/s,"
           f" peak {r['peak_hbm_gb']} GB", file=sys.stderr)
     return r
+
+
+def _dump(res):
+    """Atomic artifact write: an interrupt mid-dump must not eat the
+    previously measured (minutes-of-chip-time) rows."""
+    tmp = PATH + ".tmp"
+    json.dump(res, open(tmp, "w"), indent=2)
+    os.replace(tmp, PATH)
 
 
 def main():
@@ -98,7 +110,7 @@ def main():
         if name in res["rows"] and "error" not in res["rows"][name]:
             continue
         res["rows"][name] = run(name, *spec)
-        json.dump(res, open(PATH, "w"), indent=2)
+        _dump(res)
 
     # the sequence-parallel path at 1024: the sp entrypoint itself (ring
     # attention inside shard_map; seq axis 1 on the one-chip image — the
@@ -129,13 +141,14 @@ def main():
                {"error": text.strip().splitlines()[-1][:300]})
         res["rows"][name] = row
         print(f"{name}: {row}", file=sys.stderr)
-        try:
-            import jax
 
-            res["meta"]["device"] = jax.devices()[0].device_kind
-        except Exception:
-            pass
-        json.dump(res, open(PATH, "w"), indent=2)
+    try:
+        import jax
+
+        res["meta"]["device"] = jax.devices()[0].device_kind
+    except Exception:
+        pass
+    _dump(res)
     print(json.dumps(res["rows"], indent=2))
 
 

@@ -76,7 +76,7 @@ RUNS = [
      "save/eval every 50 steps, bf16 rotation saves, best-model reload; "
      "row is save-transport-bound: 6 x 205MB checkpoint fetches ride the "
      "tunnel, whose bulk bandwidth swings run to run — identical reruns "
-     "measured 1.56 (fast period) to 7.68 min (slow); fusion changes "
+     "measured 1.21 (fast period) to 7.68 min (slow); fusion changes "
      "nothing, confirming bytes not dispatches (see README)", 3),
     ("sp (ring attention, seq 512)", [sys.executable, "multi-tpu-sp-cls.py",
                                       "--max_seq_len", "512",
@@ -128,7 +128,7 @@ TRANSIENT = ("remote_compile", "read body", "DEADLINE_EXCEEDED")
 def run_row(name, argv, env_over, ckpt_path, note, timeout, repeat=1):
     """One strategy row.  ``repeat`` > 1 re-runs the command back-to-back and
     reports the MEDIAN minutes (each attempt kept in ``runs_min``) — used for
-    the transport-bound trainer row, where identical reruns measured 1.56 to
+    the transport-bound trainer row, where identical reruns measured 1.21 to
     7.68 min purely with tunnel bandwidth."""
     if repeat > 1:
         rows = [run_row(name, argv, env_over, ckpt_path, note, timeout)
