@@ -20,4 +20,4 @@ from pdnlp_tpu.train.run import run_sp
 from pdnlp_tpu.utils.config import Args, parse_cli
 
 if __name__ == "__main__":
-    run_sp(parse_cli(base=Args(strategy="sp", attn_dropout=0.0)))
+    run_sp(parse_cli(base=Args(strategy="sp")))

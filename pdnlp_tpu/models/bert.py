@@ -281,7 +281,11 @@ def run_layers(layers: Params, cfg: BertConfig, x: jax.Array, *,
         if seq_axis is not None:
             from pdnlp_tpu.ops.ring import ring_attention
 
-            attn = ring_attention(q, k, v, ring_bias, axis_name=seq_axis)
+            attn = ring_attention(
+                q, k, v, ring_bias, axis_name=seq_axis,
+                dropout_rate=0.0 if deterministic else cfg.attn_dropout,
+                dropout_rng=None if deterministic else jax.random.fold_in(rng, 3 * idx + 2),
+            )
         else:
             attn = dot_product_attention(
                 q, k, v, bias, impl=attn_impl,
@@ -512,8 +516,9 @@ def classify(
 
     Under ``seq_axis`` (sequence-parallel), the [CLS] position lives on
     shard 0; a masked ``psum`` broadcasts it so every shard computes the
-    same logits (attention-probability dropout is skipped on this path —
-    ``ops.ring`` has no dropout)."""
+    same logits.  Attention-probability dropout runs per ring block
+    (``ops.ring``) — same distribution as the dense path, shard-layout-
+    dependent draws."""
     if not deterministic:
         rng, enc_rng, drop_rng = jax.random.split(rng, 3)
     else:

@@ -27,9 +27,15 @@ from jax import lax
 from pdnlp_tpu.ops.attention import NEG_INF
 
 
-def _block_attn(q, k, v, bias):
+def _block_attn(q, k, v, bias, drop_key=None, keep=1.0):
     """One blockwise partial attention: returns (numerator [B,Sq,N,D],
-    rowmax m, rowsum l) in fp32 — the merge state of the online softmax."""
+    rowmax m, rowsum l) in fp32 — the merge state of the online softmax.
+
+    ``drop_key`` enables attention-probability dropout for this block: the
+    Bernoulli mask multiplies the *numerator* term only (scaled 1/keep),
+    while the rowsum ``l`` accumulates the undropped probabilities — so the
+    final ``acc / l`` equals ``dropout(softmax(s)) @ v`` exactly, the same
+    semantics as the dense path's ``dot_product_attention`` dropout."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
@@ -38,6 +44,9 @@ def _block_attn(q, k, v, bias):
     m = jnp.max(s, axis=-1, keepdims=True)              # [B,N,Sq,1]
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
+    if drop_key is not None:
+        mask = jax.random.bernoulli(drop_key, keep, p.shape)
+        p = jnp.where(mask, p / keep, 0.0)
     num = jnp.einsum("bnqk,bknd->bqnd", p, v.astype(jnp.float32))
     return num, m, l
 
@@ -48,13 +57,32 @@ def ring_attention(
     v: jax.Array,
     bias_local: Optional[jax.Array],  # [B, S_local] additive mask bias
     axis_name: str = "seq",
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Full-sequence attention for a sequence-sharded layout (must run
     inside ``shard_map`` over ``axis_name``).  Output is this shard's rows,
-    exactly equal to single-device attention over the gathered sequence."""
+    exactly equal to single-device attention over the gathered sequence.
+
+    ``dropout_rate``/``dropout_rng`` enable attention-probability dropout
+    (the reference BERT's ``attention_probs_dropout_prob``): every (q, kv)
+    block pair is visited exactly once around the ring, so an independent
+    mask per (shard, ring step) — derived by ``fold_in`` from the caller's
+    key — gives each global attention weight one i.i.d. Bernoulli draw.
+    Masks depend on the shard layout, so dropped outputs don't match the
+    single-device XLA path draw-for-draw (same as any two attention
+    backends); the *distribution* is identical (``tests/test_sp.py``)."""
     n = lax.axis_size(axis_name)
     if bias_local is None:
         bias_local = jnp.zeros(q.shape[:2], jnp.float32)
+
+    dropping = dropout_rate > 0.0 and dropout_rng is not None
+    keep = 1.0 - dropout_rate
+    base_key = (jax.random.fold_in(dropout_rng, lax.axis_index(axis_name))
+                if dropping else None)
+
+    def blk_key(i):
+        return jax.random.fold_in(base_key, i) if dropping else None
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -65,7 +93,8 @@ def ring_attention(
         # with this step's compute under XLA scheduling
         k_blk, v_blk, b_blk = jax.tree_util.tree_map(
             lambda t: lax.ppermute(t, axis_name, perm), kv)
-        num, m_blk, l_blk = _block_attn(q, k_blk, v_blk, b_blk)
+        num, m_blk, l_blk = _block_attn(q, k_blk, v_blk, b_blk,
+                                        blk_key(i), keep)
         m_new = jnp.maximum(m, m_blk)
         alpha = jnp.exp(m - m_new)                  # rescale old accumulator
         beta = jnp.exp(m_blk - m_new)               # rescale new block
@@ -75,7 +104,7 @@ def ring_attention(
         return acc, m_new, l, (k_blk, v_blk, b_blk)
 
     # step 0: this shard's own KV block, no communication
-    acc, m, l = _block_attn(q, k, v, bias_local)
+    acc, m, l = _block_attn(q, k, v, bias_local, blk_key(0), keep)
     acc, m, l, _ = lax.fori_loop(
         1, n, step, (acc, m, l, (k, v, bias_local)), unroll=True)
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)

@@ -11,7 +11,9 @@ plain ``psum`` of gradients over ``seq`` counts head parameters once.
 
 This capability has no reference twin (``SURVEY.md`` §5: long-context
 "absent"); it exists so the framework scales past single-device sequence
-lengths.  Measured on the chip at the lengths it exists for: 7.0 steps/s
+lengths.  The full dropout recipe applies — hidden-state dropout per
+shard and attention-probability dropout per ring block (``ops.ring``) —
+so sp trains the same model as every other strategy.  Measured on the chip at the lengths it exists for: 7.0 steps/s
 training ``bert-base-long`` at seq 1024 (57k tokens/s,
 ``results/longcontext.json``); multi-shard parity is pinned by
 ``tests/test_sp.py``, the multichip dryrun, and a seq axis spanning two
@@ -73,11 +75,6 @@ def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
     remat = bool(args.remat)
     unroll = _unroll(args)
     smoothing = args.label_smoothing
-    if args.attn_dropout > 0:
-        raise ValueError(
-            "sequence-parallel training has no attention-probability dropout "
-            "(ops.ring does not implement it); pass --attn_dropout 0 "
-            "explicitly so runs stay comparable across strategies")
     if getattr(args, "ema_decay", 0.0) > 0:
         raise ValueError("--ema_decay runs on the jit strategies (dp/zero/"
                          "tp/ep) — the sequence-parallel step does not "

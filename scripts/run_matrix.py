@@ -83,7 +83,6 @@ RUNS = [
                                       "--train_batch_size", "8",
                                       "--dev_batch_size", "8",
                                       "--dtype", "bfloat16",
-                                      "--attn_dropout", "0.0",
                                       *PRETRAIN, *TIMED],
      {}, "output/sp-cls.msgpack",
      "4x sequence length, batch 8, 1150 steps, bf16"),

@@ -78,6 +78,78 @@ def test_ring_attention_matches_full(ndev):
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=5e-5)
 
 
+def test_ring_attention_dropout(ndev):
+    """Attention-probability dropout inside the ring: no key is a no-op,
+    a key changes the output reproducibly, and the mean over many keys
+    converges to the undropped output (the numerator-masked online softmax
+    is unbiased — ``ops.ring._block_attn`` docstring)."""
+    from pdnlp_tpu.ops.ring import ring_attention
+
+    mesh = make_mesh(shape={"seq": ndev})
+    B, Sq, N, D = 2, 4 * ndev, 2, 8
+    r = np.random.RandomState(3)
+    q = jnp.asarray(r.randn(B, Sq, N, D), jnp.float32)
+    k = jnp.asarray(r.randn(B, Sq, N, D), jnp.float32)
+    v = jnp.asarray(r.randn(B, Sq, N, D), jnp.float32)
+    zbias = jnp.zeros((B, Sq), jnp.float32)
+
+    def make_run(rate, with_key):
+        def inner(q, k, v, b, seed):
+            key = jax.random.key(seed[0]) if with_key else None
+            return ring_attention(q, k, v, b, axis_name="seq",
+                                  dropout_rate=rate, dropout_rng=key)
+
+        return jax.jit(jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(None, "seq"),) * 4 + (P(),),
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        ))
+
+    def seed(i):
+        return jnp.asarray([i], jnp.uint32)
+
+    base = np.asarray(make_run(0.0, False)(q, k, v, zbias, seed(0)))
+    # rate > 0 without a key, and a key with rate 0, are both no-ops
+    np.testing.assert_array_equal(
+        np.asarray(make_run(0.3, False)(q, k, v, zbias, seed(0))), base)
+    np.testing.assert_array_equal(
+        np.asarray(make_run(0.0, True)(q, k, v, zbias, seed(0))), base)
+
+    drop = make_run(0.3, True)
+    a = np.asarray(drop(q, k, v, zbias, seed(1)))
+    assert not np.allclose(a, base, atol=1e-3)
+    np.testing.assert_array_equal(a, np.asarray(drop(q, k, v, zbias, seed(1))))
+
+    # unbiasedness: E[dropout(softmax) @ v] == softmax @ v (fixed seeds, so
+    # the tolerance is a one-time calibration, not a flake source)
+    acc = np.zeros_like(base)
+    K = 400
+    for i in range(K):
+        acc += np.asarray(drop(q, k, v, zbias, seed(100 + i)))
+    np.testing.assert_allclose(acc / K, base, atol=0.12)
+
+
+def test_sp_train_step_with_attn_dropout(ndev):
+    """The full sp train step with the reference's attention-probability
+    dropout enabled (the shipped entrypoint default): runs, converges on
+    repeated steps, and differs from the dropout-free trajectory."""
+    args = sp_args(attn_dropout=0.1, dropout=0.1)
+    batch = make_batch()
+    mesh = make_mesh(shape={"data": 2, "seq": 2})
+    cfg, tx, state = setup_model(args, V)
+    step = make_sp_train_step(cfg, tx, args, mesh)(batch)
+    put = make_sp_batch(mesh)
+    state1, m1 = step(state, put(batch))
+    state2, m2 = step(state1, put(batch))
+    assert np.isfinite(float(m1["loss"])) and np.isfinite(float(m2["loss"]))
+
+    cfg0, tx0, state0 = setup_model(args.replace(attn_dropout=0.0), V)
+    step0 = make_sp_train_step(cfg0, tx0, args.replace(attn_dropout=0.0), mesh)(batch)
+    _, m0 = step0(state0, put(batch))
+    assert float(m0["loss"]) != float(m1["loss"])
+
+
 @pytest.mark.parametrize("mesh_shape", [{"data": 2, "seq": 4},
                                         {"data": 1, "seq": 8}])
 def test_sp_train_step_matches_single_device(mesh_shape, ndev):
