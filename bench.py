@@ -17,8 +17,9 @@ Accuracy: the reference fine-tunes *pretrained* ``hfl/chinese-bert-wwm-ext``
 (dev acc ~0.57).  This environment has no egress, so the warm start is
 produced in-repo: ``pretrain-tpu.py`` (masked-LM over the 40k-text corpus,
 fine-tune dev split held out).  The bench fine-tunes from
-``output/pretrained.msgpack``, regenerating it first if absent (~20 min,
-one-time; reruns hit the cached file).  The pretrain stage is NOT part of
+``output/pretrained-tanh.msgpack`` (the cache name carries the activation;
+``--gelu erf`` uses ``pretrained.msgpack``), regenerating it first if
+absent (~20 min, one-time; reruns hit the cached file).  The pretrain stage is NOT part of
 the timed epoch — the reference's download of model_hub weights isn't timed
 either.
 
@@ -86,22 +87,27 @@ def main() -> None:
 
     # Recipe (r5: batch-64 sweep in results/recipe_b64_sweep.json; the r4
     # b32 grid in results/ema_sweep.json): batch 64 amortizes the step's
-    # fixed AdamW+EMA cost (+36% examples/s, ~49% bf16 MFU — ablation +
-    # XProf profile in results/profile_r05.json), 3 fine-tune epochs with
-    # linear warmup->decay at 6e-5 (lr rescaled for the doubled batch;
-    # swept optimum: 6e-5 0.5813, 4.5e-5 0.58, 8e-5 0.5787, 3e-5 0.5725),
-    # trained head restored (init_head), weight EMA at decay 0.99
-    # (evaluated/checkpointed weights are the Polyak average), best-of
+    # fixed AdamW+EMA cost (+36% examples/s — ablation + XProf profile in
+    # results/profile_r05.json); tanh GELU replaces the erf backward's VPU
+    # transcendental chain (+7% step rate at b64, ~53% bf16 MFU) and its
+    # end-to-end pretrain GAINS accuracy (3ep: 0.5887 vs erf's 0.5813);
+    # ONE fine-tune epoch with the warmup->linear-decay schedule compressed
+    # into it — the same 1-epoch protocol the reference's headline uses —
+    # measured BEST in the tanh sweep: 0.5975 (6e-5) vs 0.5938 (4.5e-5) /
+    # 0.5900-0.5950 (2ep) / 0.5887 (3ep); trained head restored
+    # (init_head), weight EMA at decay 0.99 (evaluated/checkpointed
+    # weights are the Polyak average; 0.995 regresses to 0.5850), best-of
     # checkpointing with eval every 48 steps — 48, not the reference's 50,
     # so the cadence stays exact under fuse_steps=4 (trainer.py boundary
-    # note).  Measured 0.5813 dev accuracy in ~0.36 TOTAL minutes from the
-    # MLM+sft5 pretrain (2 epochs: 0.58 in ~0.24; the r4 b32 recipe's
-    # 0.5825 needed ~0.62 total).  fuse_steps=4 rides one dispatch per 4
-    # optimizer steps over the tunneled transport (multi_step docstring).
+    # note).  fuse_steps=4 rides one dispatch per 4 optimizer steps over
+    # the tunneled transport (multi_step docstring).  The pretrain cache is
+    # keyed by activation (pretrained-tanh.msgpack vs pretrained.msgpack)
+    # so --gelu erf reruns stay reproducible against the erf artifact the
+    # per-strategy matrix protocol uses.
     args = parse_cli(base=Args(
-        strategy="dp", dtype="bfloat16", fuse_steps=4,
+        strategy="dp", dtype="bfloat16", fuse_steps=4, gelu="tanh",
         train_batch_size=64, learning_rate=6e-5,
-        epochs=3, lr_schedule="warmup_linear", ema_decay=0.99,
+        epochs=1, lr_schedule="warmup_linear", ema_decay=0.99,
         sft_epochs=5,        # measured best; --sft_epochs 0 = MLM-only warm start
         dev=True, eval_step=48,  # in-loop eval, keep best (reference ritual)
         log_every=10 ** 9,   # no per-step printing inside the timed loop
@@ -110,8 +116,13 @@ def main() -> None:
     with contextlib.redirect_stdout(sys.stderr):
         import numpy as np
 
-        pretrain_ckpt = args.ckpt_path("pretrained.msgpack")
-        mlm_ckpt = args.ckpt_path("pretrained-mlm.msgpack")
+        # cache keyed by activation: an erf-pretrained trunk silently warm-
+        # starting a tanh fine-tune (or vice versa) measured fine (0.5813)
+        # but would make the recipe's provenance depend on which run filled
+        # the cache first
+        sfx = "" if (args.gelu or "erf") == "erf" else f"-{args.gelu}"
+        pretrain_ckpt = args.ckpt_path(f"pretrained{sfx}.msgpack")
+        mlm_ckpt = args.ckpt_path(f"pretrained-mlm{sfx}.msgpack")
         explicit_init = bool(args.init_from)
         if not os.path.exists(pretrain_ckpt) and not args.init_from:
             # one-time in-repo pretraining (the "download weights" analog):
@@ -136,19 +147,19 @@ def main() -> None:
                             strategy="pretrain", train_batch_size=64,
                             epochs=150, learning_rate=2e-4, mlm_prob=0.3,
                             dev=False, lr_schedule=None, ema_decay=0.0,
-                            ckpt_name="pretrained-mlm.msgpack"))
+                            ckpt_name=f"pretrained-mlm{sfx}.msgpack"))
                     run_supervised_stage(args.replace(
                         strategy="sft", init_from=mlm_ckpt, init_head=False,
                         epochs=args.sft_epochs, learning_rate=args.sft_lr,
                         lr_schedule="warmup_linear", train_batch_size=32,
                         dev=False, ema_decay=0.0,
-                        ckpt_name="pretrained.msgpack"))
+                        ckpt_name=f"pretrained{sfx}.msgpack"))
                 else:
                     run_pretrain(args.replace(
                         strategy="pretrain", train_batch_size=64, epochs=150,
                         learning_rate=2e-4, mlm_prob=0.3, dev=False,
                         lr_schedule=None, ema_decay=0.0,
-                        ckpt_name="pretrained.msgpack"))
+                        ckpt_name=f"pretrained{sfx}.msgpack"))
             except Exception as e:  # bench must still produce its JSON line
                 print(f"pretrain stage failed ({type(e).__name__}: {e})",
                       file=sys.stderr)
@@ -200,7 +211,11 @@ def main() -> None:
         host_batch = next(iter(train_loader))
         batch = trainer.put(host_batch)
         trainer.train_step.lower(trainer.state, batch).compile()
-        trainer.eval_step.lower(trainer.state["params"], batch).compile()
+        # eval must lower against a DEV-loader batch: dev_batch_size differs
+        # from the train batch, and a mismatched shape here would push the
+        # real eval compile inside the timed loop on a cold XLA cache
+        dev_batch = trainer.put(next(iter(dev_loader)))
+        trainer.eval_step.lower(trainer.state["params"], dev_batch).compile()
         if trainer.multi_step is not None:
             stacked = {k: np.stack([v] * args.fuse_steps)
                        for k, v in host_batch.items()}

@@ -47,6 +47,28 @@ def _fuse_qkv() -> bool:
     return os.environ.get("PDNLP_FUSE_QKV", "0") == "1"
 
 
+def _gelu(x, form: str = "erf"):
+    """GELU — ``form`` comes from ``cfg.gelu`` at every call site.
+
+    ``"erf"`` is the exact form — the reference BERT's activation
+    (``transformers`` ``hidden_act="gelu"``).  ``"tanh"`` trades the erf
+    backward (a VPU transcendental chain the step profile priced at
+    ~3.3 ms — ``results/profile_r05.json`` "exact-GELU backward") for a
+    cheaper polynomial; max |Δ| vs erf is ~4e-4, and the shipped recipe
+    measured +7% step rate AND +0.7pt fine-tune accuracy when pretrained
+    with it end to end (0.5887 vs erf's 0.5813 — bench.py recipe note).
+    ``PDNLP_GELU_TANH=1`` force-enables tanh regardless of config — the
+    A/B profiling override (``scripts/profile_step.py``)."""
+    import os
+
+    if form not in ("erf", "tanh"):
+        # loud: a typo'd --gelu would otherwise silently run erf while
+        # bench.py keys its pretrain cache on the raw string
+        raise ValueError(f"gelu must be 'erf' or 'tanh', got {form!r}")
+    approx = form == "tanh" or os.environ.get("PDNLP_GELU_TANH", "0") == "1"
+    return jax.nn.gelu(x, approximate=approx)
+
+
 # --------------------------------------------------------------------------
 # init
 # --------------------------------------------------------------------------
@@ -308,7 +330,7 @@ def run_layers(layers: Params, cfg: BertConfig, x: jax.Array, *,
         x, rng = carry
         lp, idx = scanned
         x = attn_block(x, lp, idx, rng)
-        h = jax.nn.gelu(_dense(x, lp["up"], dtype), approximate=False)
+        h = _gelu(_dense(x, lp["up"], dtype), cfg.gelu)
         h = _dense(h, lp["down"], dtype)
         x = mlp_out(x, lp, idx, rng, h)
         return (x, rng), None
@@ -393,7 +415,7 @@ def moe_mlp(x: jax.Array, lp: Params, cfg: BertConfig, *, dtype=jnp.float32,
         down_k, down_b = lp["down"]["kernel"], lp["down"]["bias"]
         h = jnp.einsum("bsh,ehi->ebsi", x, up_k.astype(dtype)) \
             + up_b.astype(dtype)[:, None, None, :]
-        h = jax.nn.gelu(h, approximate=False)
+        h = _gelu(h, cfg.gelu)
         y = jnp.einsum("ebsi,eih->ebsh", h, down_k.astype(dtype)) \
             + down_b.astype(dtype)[:, None, None, :]
         out = jnp.einsum("ebsh,bse->bsh", y, combine.astype(dtype))
@@ -457,7 +479,7 @@ def _moe_grouped(x: jax.Array, lp: Params, top_idx: jax.Array,
     xe = jnp.concatenate([x2, jnp.zeros((1, H), x2.dtype)])[slot_tok]
     h = jnp.einsum("ech,ehi->eci", xe, lp["up"]["kernel"].astype(dtype)) \
         + lp["up"]["bias"].astype(dtype)[:, None, :]
-    h = jax.nn.gelu(h, approximate=False)
+    h = _gelu(h, cfg.gelu)
     y = jnp.einsum("eci,eih->ech", h, lp["down"]["kernel"].astype(dtype)) \
         + lp["down"]["bias"].astype(dtype)[:, None, :]
     y = y * slot_w[..., None].astype(dtype)           # sentinel slots -> 0
@@ -488,7 +510,7 @@ def mlm_logits(params: Params, head: Params, cfg: BertConfig,
     The decoder weight is ``params['embeddings']['word']`` transposed (weight
     tying): on a corpus this small the embedding table gets gradient signal
     from every masked position, not just from input lookups."""
-    h = jax.nn.gelu(_dense(hidden, head["transform"], dtype), approximate=False)
+    h = _gelu(_dense(hidden, head["transform"], dtype), cfg.gelu)
     h = _layer_norm(h, head["ln"]["scale"], head["ln"]["bias"], cfg.layer_norm_eps)
     word = params["embeddings"]["word"].astype(dtype)
     logits = jnp.einsum("bsh,vh->bsv", h, word) + head["bias"].astype(dtype)

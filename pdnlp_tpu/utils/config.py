@@ -121,6 +121,11 @@ class Args:
     moe_top_k: Optional[int] = None               # experts combined/token
     moe_experts: Optional[int] = None             # expert count override
                                                   # (scaling experiments)
+    gelu: Optional[str] = None                    # erf|tanh activation
+                                                  # (None = model-config
+                                                  # default "erf"; tanh
+                                                  # measured +7% step rate,
+                                                  # models/config.py)
     accel_config: Optional[str] = None            # Accelerator machine-config
                                                   # file (JSON/YAML, the
                                                   # default_config.yaml

@@ -136,7 +136,23 @@ def main():
             {**base, "grads_dtype": "compute", "scan_unroll": 1}, off),
         "b64": ({**base, "train_batch_size": 64}, off),
         "b128": ({**base, "train_batch_size": 128}, off),
+        # tanh-GELU A/B (PDNLP_GELU_TANH): prices the exact-erf backward the
+        # trace attributes ~3.3 ms/step to; a different model, so measured
+        # here rather than shipped (models/bert.py:_gelu)
+        "gelu_tanh": (base, {**off, "PDNLP_GELU_TANH": "1"}),
+        "gelu_tanh_b64": ({**base, "train_batch_size": 64},
+                          {**off, "PDNLP_GELU_TANH": "1"}),
     }
+    if len(sys.argv) > 1:
+        if len(sys.argv) != 3 or sys.argv[1] != "--only":
+            sys.exit(f"usage: {sys.argv[0]} [--only name,name,...]  "
+                     f"(variants: {', '.join(variants)})")
+        only = set(sys.argv[2].split(","))
+        unknown = only - set(variants)
+        if unknown:
+            sys.exit(f"unknown variant(s): {', '.join(sorted(unknown))}  "
+                     f"(variants: {', '.join(variants)})")
+        variants = {k: v for k, v in variants.items() if k in only}
     # merge onto any existing artifact: reruns refresh rows, never drop the
     # rows (and analysis) other files cite as evidence
     path = os.path.join(REPO, "results", "profile_r05.json")
@@ -148,7 +164,8 @@ def main():
     for name, (kw, env) in variants.items():
         td = trace_dir if name == "base_split_qkv" else None
         r = probe(kw, env=env, trace_dir=td)
-        results[name] = r
+        if r is not None:  # a failed probe must not null out a measured
+            results[name] = r  # row the README/analysis cite (merge invariant)
         print(f"{name}: {r}", file=sys.stderr)
 
     out = dict(prior)
@@ -156,8 +173,9 @@ def main():
         "device": None,
         "config": "bert-base b32 s128 bf16 (bench recipe, fuse_steps=1 probe)",
         "variants": results,
-        "trace": parse_trace(trace_dir),
     })
+    if "base_split_qkv" in variants:  # trace only re-captured on a full run
+        out["trace"] = parse_trace(trace_dir)
     try:
         import jax
 

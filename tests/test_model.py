@@ -105,3 +105,27 @@ def test_param_count_bert_base_matches_reference_scale():
     tiny = get_config("bert-tiny", vocab_size=100)
     p = bert.init_params(jax.random.key(0), tiny)
     assert bert.param_count(p) > 0
+
+
+def test_gelu_config_knob(cfg, params, batch):
+    """``cfg.gelu`` selects the activation: the registry default is exact
+    erf (the reference model); "tanh" changes the forward by at most the
+    approximation error, and an Args-level ``--gelu`` override reaches the
+    config (``models/config.py:args_overrides``)."""
+    from pdnlp_tpu.models.config import args_overrides
+    from pdnlp_tpu.utils.config import Args
+
+    assert cfg.gelu == "erf"
+    a = bert.classify(params, cfg, batch)
+    b = bert.classify(params, cfg.replace(gelu="tanh"), batch)
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
+
+    assert "gelu" not in args_overrides(Args())  # None keeps the default
+    assert args_overrides(Args(gelu="tanh"))["gelu"] == "tanh"
+    assert get_config("bert-base", **args_overrides(Args(gelu="tanh"))).gelu == "tanh"
+
+    # a typo'd value must fail loudly, not silently run erf (bench.py keys
+    # its pretrain cache on the raw string)
+    with pytest.raises(ValueError, match="gelu"):
+        bert.classify(params, cfg.replace(gelu="Tanh"), batch)
