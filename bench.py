@@ -93,8 +93,10 @@ def main() -> None:
     # end-to-end pretrain GAINS accuracy (3ep: 0.5887 vs erf's 0.5813);
     # ONE fine-tune epoch with the warmup->linear-decay schedule compressed
     # into it — the same 1-epoch protocol the reference's headline uses —
-    # measured BEST in the tanh sweep: 0.5975 (6e-5) vs 0.5938 (4.5e-5) /
-    # 0.5900-0.5950 (2ep) / 0.5887 (3ep); trained head restored
+    # measured BEST in the tanh sweep: 0.5975 (6e-5) vs 0.5925/0.5938 at
+    # the 5e-5/7e-5 half-steps, 0.5938 (4.5e-5), 0.5900-0.5950 (2ep),
+    # 0.5887 (3ep); eval cadence 24 finds the same 0.5975 best (cadence
+    # stays 48); trained head restored
     # (init_head), weight EMA at decay 0.99 (evaluated/checkpointed
     # weights are the Polyak average; 0.995 regresses to 0.5850), best-of
     # checkpointing with eval every 48 steps — 48, not the reference's 50,

@@ -142,6 +142,8 @@ def main():
         "gelu_tanh": (base, {**off, "PDNLP_GELU_TANH": "1"}),
         "gelu_tanh_b64": ({**base, "train_batch_size": 64},
                           {**off, "PDNLP_GELU_TANH": "1"}),
+        "gelu_tanh_b128": ({**base, "train_batch_size": 128},
+                           {**off, "PDNLP_GELU_TANH": "1"}),
     }
     if len(sys.argv) > 1:
         if len(sys.argv) != 3 or sys.argv[1] != "--only":

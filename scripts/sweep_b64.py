@@ -97,7 +97,18 @@ def main():
         learning_rate=6e-5, ema_decay=0.995, epochs=1, **tanh)
     grid["tanh_b64_lr6e-05_ema0.99_3ep"] = dict(
         learning_rate=6e-5, ema_decay=0.99, epochs=3, **tanh)
-    only = sys.argv[1:]
+    # pin the 1-epoch optimum: lr half-steps around the 6e-5 winner, and a
+    # finer eval cadence (fuse_steps 4 divides 24, keeping eval boundaries
+    # exact; more best-candidates per epoch at ~2s extra eval cost with
+    # the device-cached dev set)
+    for lr in (5e-5, 7e-5):
+        grid[f"tanh_b64_lr{lr:g}_ema0.99_1ep"] = dict(
+            learning_rate=lr, ema_decay=0.99, epochs=1, **tanh)
+    grid["tanh_b64_lr6e-05_ema0.99_1ep_eval24"] = dict(
+        learning_rate=6e-5, ema_decay=0.99, epochs=1, eval_step=24, **tanh)
+    # accept space- AND comma-separated name substrings (a comma list
+    # otherwise matches nothing and the run silently does no work)
+    only = [t for a in sys.argv[1:] for t in a.split(",") if t]
     for name, kw in grid.items():
         if only and not any(o in name for o in only):
             continue

@@ -227,3 +227,22 @@ def test_ema_weights_tracked_and_evaluated():
                                         100, mesh, "dp")
     with _pytest.raises(ValueError, match="ema_decay"):
         make_shardmap_train_step(cfg, tx, args, mesh)
+
+
+def test_eval_batches_uploaded_once(cfg, args):
+    """The dev set is device-cached across evals: ``put`` runs once per
+    distinct loader, not once per eval (the transport property the bench's
+    in-loop eval cadence relies on — ``trainer._eval_cache``)."""
+    state, tx = _state_and_tx(cfg, args)
+    puts = []
+    tr = Trainer(args, cfg, state,
+                 make_train_step(cfg, tx, args), make_eval_step(cfg, args),
+                 put=lambda b: puts.append(1) or b)
+    dev = _ListLoader([_batch(cfg, seed=9), _batch(cfg, seed=10)])
+    first = tr.dev(dev)
+    assert len(puts) == 2
+    assert tr.dev(dev) == first  # same params, cached device batches
+    assert len(puts) == 2        # no re-upload on the second eval
+    other = _ListLoader([_batch(cfg, seed=11)])
+    tr.dev(other)                # a different loader replaces the cache
+    assert len(puts) == 3
