@@ -77,7 +77,132 @@ def step_flops(cfg, batch: int, seq: int) -> float:
     return dense + attn
 
 
+def serve_smoke(argv) -> None:
+    """``--serve``: inference-serving smoke over the offline path.
+
+    N mixed-length requests spanning >= 3 sequence buckets, driven through
+    ``pdnlp_tpu.serve`` after a bucket warmup.  Reports req/s, latency
+    p50/p99, batch occupancy, compile-cache hit/miss and — the acceptance
+    bar — the retrace count AFTER warmup, which must be zero: steady-state
+    serving never re-traces.  Writes the snapshot to ``results/
+    serve_smoke.json`` (override: ``--serve_out``); request count:
+    ``--serve_requests`` (default 120).  Deterministic and CPU-safe: texts
+    are synthesized from a seeded RNG (over the corpus vocab when present,
+    a fixed CJK set otherwise), so the smoke needs no dataset or
+    checkpoint — though a checkpoint under ``--output_dir`` is used when
+    one exists.
+    """
+    import random
+    import time
+
+    import jax
+
+    from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
+    from pdnlp_tpu.parallel import make_mesh
+    from pdnlp_tpu.serve import InferenceEngine
+    from pdnlp_tpu.serve.offline import score_texts
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, n_requests = pop_cli_flag(argv, "--serve_requests", 120, int)
+    argv, out_path = pop_cli_flag(
+        argv, "--serve_out", os.path.join("results", "serve_smoke.json"))
+    args = parse_cli(argv, base=Args())
+
+    # deterministic mixed-length traffic: char counts sized so token lengths
+    # (chars + [CLS]/[SEP]) land in the 32/64/128 buckets
+    chars = "天地人你我他好坏大小上下来去爱恨喜怒哀乐高兴悲伤讨厌愤怒"
+    rng = random.Random(args.seed)
+    lengths = [10, 24, 48, 60, 100, 120]
+    texts = ["".join(rng.choice(chars) for _ in range(lengths[i % len(lengths)]))
+             for i in range(n_requests)]
+
+    if os.path.exists(args.data_path) or os.path.exists(args.vocab_path):
+        from pdnlp_tpu.data.tokenizer import get_or_build_vocab
+
+        tok = WordPieceTokenizer(get_or_build_vocab(args))
+    else:
+        # no corpus on this host: a vocab over the synthetic char set keeps
+        # the smoke self-contained (latency/retrace numbers don't care)
+        tok = WordPieceTokenizer(build_vocab(texts, size=256))
+
+    buckets = (32, 64, 128)
+    batch_size = 8
+    mesh = make_mesh(num_devices=args.num_devices, shape=args.mesh_shape)
+    engine = InferenceEngine(args, tokenizer=tok, mesh=mesh)
+    from pdnlp_tpu.train import checkpoint as ckpt_mod
+
+    ckpt_path = ckpt_mod.latest(args.output_dir)
+    if ckpt_path:
+        try:
+            engine.load_checkpoint(ckpt_path)
+        except Exception as e:
+            print(f"checkpoint {ckpt_path} not loadable ({e}); "
+                  "serving init weights", file=sys.stderr)
+
+    engine.warmup(buckets, engine.pad_rows(batch_size))
+    retraces_warmup = engine.metrics.retraces.value
+
+    t0 = time.monotonic()
+    preds, _ = score_texts(engine, texts, buckets=buckets,
+                           batch_size=batch_size)
+    elapsed = time.monotonic() - t0
+
+    snap = engine.metrics.snapshot()
+    retraces_post = engine.metrics.retraces.value - retraces_warmup
+    result = {
+        "metric": "serve_smoke",
+        "requests": n_requests,
+        "req_per_sec": round(n_requests / elapsed, 2),
+        "elapsed_sec": round(elapsed, 3),
+        "latency_ms_p50": snap["request_latency_ms"]["p50"],
+        "latency_ms_p99": snap["request_latency_ms"]["p99"],
+        "batch_occupancy_mean": snap["batch_occupancy"]["mean"],
+        "buckets": list(buckets),
+        "batch_size": batch_size,
+        "retraces_warmup": retraces_warmup,
+        "retraces_post_warmup": retraces_post,
+        "cache_hits": snap["compile_cache"]["hits"],
+        "cache_misses": snap["compile_cache"]["misses"],
+        "checkpoint": engine.checkpoint_path,
+        "model": args.model,
+        "dtype": args.dtype,
+        "devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+        "metrics": snap,
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, out_path)
+    print(json.dumps({k: v for k, v in result.items() if k != "metrics"}))
+    if retraces_post != 0:
+        # the smoke's whole point: steady-state serving never re-traces.
+        # A nonzero count here is a shape-stability regression (dtype/
+        # weak-type drift, bucket plumbing) — fail loudly, snapshot kept.
+        sys.exit(f"serve smoke FAILED: {retraces_post} post-warmup retraces "
+                 f"(expected 0) — see {out_path}")
+
+
 def main() -> None:
+    # A leaked PDNLP_GELU_TANH would force tanh on EVERY forward regardless
+    # of --gelu, while the pretrain cache below keys its artifact name on
+    # --gelu alone — a tanh trunk would silently land in the erf-named
+    # pretrained.msgpack and corrupt the provenance the activation-keyed
+    # cache exists to protect.  Refuse outright; the env override belongs
+    # to scripts/profile_step.py's A/B subprocesses only.
+    if os.environ.get("PDNLP_GELU_TANH", "0") == "1":
+        sys.exit("bench.py: PDNLP_GELU_TANH is set — this global activation "
+                 "override would desynchronize the activation-keyed pretrain "
+                 "cache (pretrained[-tanh].msgpack) from the weights actually "
+                 "produced.  Unset it and select the activation with --gelu.")
+
+    argv = sys.argv[1:]
+    if "--serve" in argv:
+        argv.remove("--serve")
+        return serve_smoke(argv)
+
     import jax
 
     jax.config.update("jax_compilation_cache_dir", "output/xla_cache")

@@ -52,6 +52,38 @@ def _pad_rows(a: np.ndarray, rows: int) -> np.ndarray:
     return out
 
 
+def pad_ids_to_bucket(id_lists: Sequence[Sequence[int]], seq_len: int,
+                      rows: int = 0, pad_id: int = 0) -> Batch:
+    """Ragged token-id lists -> one fixed ``[rows, seq_len]`` batch.
+
+    The serving twin of :class:`Collator`: requests arrive pre-encoded but
+    unpadded (their true length picked the bucket — ``serve.batcher``), and
+    the batch pads every row to the bucket length and the row count up to
+    ``rows`` with zero-weight filler, so one compiled forward per
+    ``(seq_len, rows)`` shape covers every batch in the bucket.  Rows longer
+    than ``seq_len`` are a caller bug (the bucket must cover its rows) and
+    raise rather than silently truncate.
+    """
+    n = len(id_lists)
+    rows = max(rows, n)
+    input_ids = np.full((rows, seq_len), pad_id, dtype=np.int32)
+    attention_mask = np.zeros((rows, seq_len), dtype=np.int32)
+    for i, ids in enumerate(id_lists):
+        if len(ids) > seq_len:
+            raise ValueError(f"row {i} has {len(ids)} tokens > bucket "
+                             f"{seq_len} — pick_bucket must cover its rows")
+        input_ids[i, : len(ids)] = ids
+        attention_mask[i, : len(ids)] = 1
+    w = np.zeros((rows,), np.float32)
+    w[:n] = 1.0
+    return {
+        "input_ids": input_ids,
+        "attention_mask": attention_mask,
+        "token_type_ids": np.zeros((rows, seq_len), dtype=np.int32),
+        "example_weight": w,
+    }
+
+
 class EncodedDataset:
     """The whole split tokenized ONCE into contiguous arrays.
 

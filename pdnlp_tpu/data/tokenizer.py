@@ -188,6 +188,12 @@ class WordPieceTokenizer:
         mask += [0] * pad
         return ids, mask, [0] * max_len
 
+    def encode_ragged(self, texts: Sequence[str], max_len: int = 128) -> List[List[int]]:
+        """Unpadded ``[CLS] ids [SEP]`` per text — the serving front half:
+        true lengths pick the pad bucket (``serve.batcher.pick_bucket``)
+        before ``data.collate.pad_ids_to_bucket`` fixes the shape."""
+        return [self.encode_ids(t, max_len) for t in texts]
+
     def encode_batch(self, texts: Sequence[str], max_len: int = 128) -> Dict[str, np.ndarray]:
         if self._native is not None:
             return self._native.encode_batch(texts, max_len)

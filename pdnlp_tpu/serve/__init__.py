@@ -1,0 +1,37 @@
+"""Batched inference serving — the subsystem ``predict_tpu.py`` lacked.
+
+Training in this repo already kills the two costs that dominate BERT-class
+serving (XLA retraces on ragged shapes; idle accelerator time between
+requests) — this package applies the same treatment to inference:
+
+- :mod:`pdnlp_tpu.serve.engine` — a long-lived jitted sharded forward over
+  the existing mesh/sharding stack, with a compiled-function cache keyed on
+  ``(bucket_seq_len, batch_rows)`` so steady-state serving never retraces;
+- :mod:`pdnlp_tpu.serve.batcher` — bounded request queue with dynamic
+  micro-batching (flush on size or ``max_wait_ms``), sequence-length
+  bucketing, backpressure and per-request deadlines;
+- :mod:`pdnlp_tpu.serve.metrics` — latency/occupancy/cache observability,
+  JSON-snapshot compatible with the ``results/`` artifacts;
+- :mod:`pdnlp_tpu.serve.offline` — high-throughput whole-file scoring over
+  the same bucketing (the deterministic surface tests and ``bench.py`` use).
+
+Entry point: ``serve_tpu.py`` at the repo root.
+"""
+from pdnlp_tpu.serve.batcher import (  # noqa: F401
+    DEFAULT_BUCKETS, DeadlineExceeded, DynamicBatcher, QueueFullError,
+    pick_bucket,
+)
+from pdnlp_tpu.serve.engine import InferenceEngine  # noqa: F401
+from pdnlp_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from pdnlp_tpu.serve.offline import score_texts  # noqa: F401
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "DeadlineExceeded",
+    "DynamicBatcher",
+    "InferenceEngine",
+    "QueueFullError",
+    "ServeMetrics",
+    "pick_bucket",
+    "score_texts",
+]

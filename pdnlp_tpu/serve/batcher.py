@@ -1,0 +1,292 @@
+"""Dynamic micro-batching over a bounded queue — the Orca/vLLM idea in its
+fixed-shape classifier form.
+
+Requests arrive one at a time; the accelerator wants full fixed-shape
+batches.  The batcher bridges the two:
+
+- **bucketing**: each request's true token length picks the smallest
+  covering bucket (default 32/64/128/...); per-bucket queues keep batches
+  shape-homogeneous so the engine's compile cache stays tiny and hot;
+- **flush policy**: a bucket flushes when it holds ``max_batch_size``
+  requests (throughput bound) or when its oldest request has waited
+  ``max_wait_ms`` (latency bound) — the classic size-or-timeout trigger;
+- **backpressure**: ``submit`` raises :class:`QueueFullError` once
+  ``max_queue`` requests are pending — reject-with-error beats unbounded
+  memory growth and tells the caller to shed load;
+- **deadlines**: a request whose deadline passes while still queued is
+  completed with :class:`DeadlineExceeded` and dropped from its batch, so
+  one stuck client degrades gracefully instead of stalling the queue.
+
+One worker thread owns the engine (JAX dispatch is not thread-safe-by-
+contract here, and a single dispatcher keeps the device busy without lock
+churn); submitters block only on their own result.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pdnlp_tpu.serve.engine import InferenceEngine
+from pdnlp_tpu.serve.metrics import ServeMetrics
+
+DEFAULT_BUCKETS = (32, 64, 128, 256, 512)
+
+
+class QueueFullError(RuntimeError):
+    """Raised by ``submit`` when the bounded queue is at capacity."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request's deadline passed before its batch executed."""
+
+
+def usable_buckets(buckets: Sequence[int], max_seq_len: int) -> tuple:
+    """The bucket list every serve path actually uses: capped at the
+    model's padded length (encode truncates there, so a larger bucket could
+    never fill) and never empty.  ONE definition — the batcher, the offline
+    scorer and the CLI must clamp identically or a request could land in a
+    bucket another path would reject."""
+    usable = tuple(sorted(b for b in buckets if b <= max_seq_len))
+    return usable or (int(max_seq_len),)
+
+
+def pick_bucket(n_tokens: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket covering ``n_tokens`` (largest bucket if none does —
+    entry paths truncate rows to the largest bucket, so topping out is the
+    matching choice, not an error)."""
+    for b in sorted(buckets):
+        if n_tokens <= b:
+            return b
+    return max(buckets)
+
+
+class _Request:
+    __slots__ = ("ids", "bucket", "submitted", "deadline", "_event",
+                 "_logits", "_error")
+
+    def __init__(self, ids: List[int], bucket: int,
+                 deadline: Optional[float]):
+        self.ids = ids
+        self.bucket = bucket
+        self.submitted = time.monotonic()
+        self.deadline = deadline  # absolute monotonic seconds, or None
+        self._event = threading.Event()
+        self._logits: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+
+    # --- the caller-facing future half ---
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for the logits row; raises the request's error if it was
+        rejected by deadline or failed in the engine."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request still pending")
+        if self._error is not None:
+            raise self._error
+        return self._logits
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    # --- the worker-facing completion half ---
+    def _complete(self, logits: Optional[np.ndarray],
+                  error: Optional[BaseException] = None) -> None:
+        self._logits = logits
+        self._error = error
+        self._event.set()
+
+
+class DynamicBatcher:
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_batch_size: int = 8,
+        max_wait_ms: float = 5.0,
+        max_queue: int = 256,
+        default_deadline_ms: Optional[float] = None,
+    ):
+        self.engine = engine
+        self.buckets = usable_buckets(buckets, engine.args.max_seq_len)
+        # flush threshold = the PADDED row count: executed batches pad rows
+        # to the mesh's data-axis multiple anyway, so flushing at a smaller
+        # size would cap occupancy below 1.0 forever (e.g. data axis 8 with
+        # max_batch_size 4 -> every batch half filler even under load)
+        self.max_batch_size = engine.pad_rows(int(max_batch_size))
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue = int(max_queue)
+        self.default_deadline_ms = default_deadline_ms
+        self.metrics: ServeMetrics = engine.metrics
+        self._queues: Dict[int, List[_Request]] = {b: [] for b in self.buckets}
+        self._pending = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = False
+        self._worker: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "DynamicBatcher":
+        if self._worker is None:
+            self._stop = False  # a stopped batcher restarts cleanly
+            self._worker = threading.Thread(target=self._run, daemon=True,
+                                            name="pdnlp-serve-batcher")
+            self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Shut the worker down; ``drain=True`` serves what is queued first."""
+        if self._worker is None:
+            return
+        if drain:
+            with self._lock:
+                while self._pending and not self._stop:
+                    self._wake.wait(timeout=0.05)
+        with self._lock:
+            self._stop = True
+            self._wake.notify_all()
+        self._worker.join(timeout=10)
+        self._worker = None
+        with self._lock:  # fail anything still queued (stop(drain=False))
+            leftovers = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+            self._pending = 0
+            self.metrics.queue_depth.set(0)
+        for r in leftovers:
+            r._complete(None, RuntimeError("batcher stopped"))
+
+    def __enter__(self) -> "DynamicBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, text: str,
+               deadline_ms: Optional[float] = None) -> _Request:
+        """Enqueue one text; returns a future-like whose ``result()`` is the
+        logits row.  Raises :class:`QueueFullError` at capacity (the
+        backpressure contract: callers retry or shed).
+
+        Encoding truncates to the LARGEST bucket, not ``max_seq_len`` — a
+        bucket list topping out below the model's padded length is a valid
+        config, and a row no bucket covers would otherwise fail its whole
+        batch at execute time."""
+        ids = self.engine.tokenizer.encode_ids(text, self.buckets[-1])
+        return self.submit_ids(ids, deadline_ms=deadline_ms)
+
+    def submit_ids(self, ids: List[int],
+                   deadline_ms: Optional[float] = None) -> _Request:
+        if len(ids) > self.buckets[-1]:
+            # pre-encoded rows get a plain tail truncation (only submit()'s
+            # text path knows the [CLS]/[SEP] framing to preserve) — a row
+            # that cannot fit any bucket must never reach a batch, where
+            # its shape error would poison every co-batched request
+            ids = list(ids)[: self.buckets[-1]]
+        deadline_ms = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        req = _Request(ids, pick_bucket(len(ids), self.buckets), deadline)
+        with self._lock:
+            if self._stop or self._worker is None:
+                raise RuntimeError("batcher is not running (call start())")
+            if self._pending >= self.max_queue:
+                self.metrics.rejected_total.inc()
+                raise QueueFullError(
+                    f"queue full ({self._pending}/{self.max_queue})")
+            self._queues[req.bucket].append(req)
+            self._pending += 1
+            self.metrics.requests_total.inc()
+            self.metrics.queue_depth.set(self._pending)
+            self._wake.notify()
+        return req
+
+    # ------------------------------------------------------------- worker
+    def _take_flushable(self) -> Optional[List[_Request]]:
+        """Under the lock: pop a full bucket, an aged one, or None."""
+        now = time.monotonic()
+        # expired-deadline requests leave their queue before batch selection
+        # (their slot should not hold a flush back or ride a batch)
+        expired: List[_Request] = []
+        for q in self._queues.values():
+            keep = []
+            for r in q:
+                if r.deadline is not None and now >= r.deadline:
+                    expired.append(r)
+                else:
+                    keep.append(r)
+            q[:] = keep
+        if expired:
+            self._pending -= len(expired)
+            self.metrics.deadline_expired_total.inc(len(expired))
+            self.metrics.queue_depth.set(self._pending)
+            for r in expired:
+                r._complete(None, DeadlineExceeded(
+                    "deadline passed while queued"))
+        # full bucket first (throughput); else the most-overdue aged bucket
+        for b, q in self._queues.items():
+            if len(q) >= self.max_batch_size:
+                return self._pop(b, self.max_batch_size)
+        aged = [(q[0].submitted, b) for b, q in self._queues.items() if q]
+        if aged:
+            oldest, b = min(aged)
+            if (now - oldest) * 1e3 >= self.max_wait_ms:
+                return self._pop(b, self.max_batch_size)
+        return None
+
+    def _pop(self, bucket: int, n: int) -> List[_Request]:
+        q = self._queues[bucket]
+        batch, q[:] = q[:n], q[n:]
+        self._pending -= len(batch)
+        self.metrics.queue_depth.set(self._pending)
+        return batch
+
+    def _next_wakeup(self) -> Optional[float]:
+        """Seconds until the earliest timeout/deadline, or None to sleep."""
+        now = time.monotonic()
+        ticks = []
+        for q in self._queues.values():
+            for r in q:
+                ticks.append(r.submitted + self.max_wait_ms / 1e3)
+                if r.deadline is not None:
+                    ticks.append(r.deadline)
+        if not ticks:
+            return None
+        return max(0.0, min(ticks) - now)
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                batch = self._take_flushable()
+                if batch is None:
+                    if self._stop:
+                        return
+                    self._wake.wait(timeout=self._next_wakeup())
+                    continue
+            self._execute(batch)
+            with self._lock:
+                self._wake.notify_all()  # unblock stop(drain=True) waiters
+
+    def _execute(self, batch: List[_Request]) -> None:
+        bucket = batch[0].bucket
+        t0 = time.monotonic()
+        for r in batch:
+            self.metrics.queue_wait_ms.observe((t0 - r.submitted) * 1e3)
+        try:
+            rows = self.max_batch_size  # already padded to the mesh multiple
+            logits = self.engine.infer_ids([r.ids for r in batch], bucket,
+                                           rows=rows)
+            self.metrics.batches_total.inc()
+            self.metrics.batch_occupancy.observe(len(batch) / rows)
+            done = time.monotonic()
+            for i, r in enumerate(batch):
+                self.metrics.request_latency_ms.observe(
+                    (done - r.submitted) * 1e3)
+                r._complete(logits[i])
+        except BaseException as e:  # noqa: BLE001 — a failed batch must
+            for r in batch:        # never leave callers blocked forever
+                r._complete(None, e)

@@ -1,0 +1,173 @@
+"""Long-lived inference engine: checkpoint -> jitted sharded forward.
+
+``predict_tpu.py``'s original inline ``@jax.jit`` forward re-traced on every
+new input shape and re-assembled the model per process.  The engine keeps
+one process-lifetime forward instead:
+
+- **checkpoint load** goes through ``pdnlp_tpu.train.checkpoint`` —
+  shape-validated against the model template, so a ``bert-tiny`` file into a
+  ``bert-base`` engine fails loudly at load, not as an XLA error mid-request
+  (``load_raw`` pre-checks the embedding shape before any device transfer);
+- **placement** rides the existing ``parallel.mesh``/``sharding`` machinery:
+  params replicated over the data axis, batches split along it — inference
+  is embarrassingly data-parallel, so the DDP layout is the right one (pass
+  ``mesh=None`` for plain single-device jit, bitwise-identical to the old
+  ``predict_tpu.py`` forward);
+- **compile cache**: ``jax.jit`` already caches traces by shape, but
+  silently — the engine tracks every ``(bucket_seq_len, batch_rows)`` shape
+  it has served and counts hits/misses, and a counter INSIDE the traced
+  function counts actual retraces (the Python body only runs when XLA
+  traces), so "steady-state serving never retraces" is a measured property,
+  not a hope.  ``warmup()`` pre-traces every bucket shape so the first real
+  request never pays a compile.
+
+Params can be swapped (``load_checkpoint``) without invalidating the cache:
+the trace depends on shapes only, and every strategy checkpoint shares the
+template's shapes — that is what lets ``predict_tpu.py`` sweep N checkpoints
+through ONE compiled forward.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from pdnlp_tpu.data.collate import pad_ids_to_bucket
+from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, get_or_build_vocab
+from pdnlp_tpu.models import bert, get_config
+from pdnlp_tpu.models.config import args_overrides
+from pdnlp_tpu.serve.metrics import ServeMetrics
+from pdnlp_tpu.train import checkpoint as ckpt
+from pdnlp_tpu.train.precision import resolve_dtype
+
+
+class InferenceEngine:
+    def __init__(self, args, tokenizer: Optional[WordPieceTokenizer] = None,
+                 *, mesh=None, metrics: Optional[ServeMetrics] = None):
+        """``args`` supplies model/dtype/vocab knobs (an ``utils.config.Args``).
+
+        ``mesh=None`` means plain ``jax.jit`` on the default device — the
+        exact forward ``predict_tpu.py`` always ran.  With a mesh, batches
+        shard along ``data`` and batch rows are padded up to a multiple of
+        the axis size (``rows_multiple``).
+        """
+        self.args = args
+        self.tokenizer = tokenizer or WordPieceTokenizer(get_or_build_vocab(args))
+        self.cfg = get_config(args.model, vocab_size=self.tokenizer.vocab_size,
+                              num_labels=args.num_labels, dropout=args.dropout,
+                              attn_dropout=args.attn_dropout,
+                              **args_overrides(args))
+        self.dtype = resolve_dtype(args.dtype)
+        self.mesh = mesh
+        self.metrics = metrics or ServeMetrics()
+        self.rows_multiple = int(mesh.shape.get("data", 1)) if mesh else 1
+        # the template: init-shaped params every checkpoint must match
+        # (predict/test sweep semantics — setup_model's init, minus the
+        # optimizer state serving never needs)
+        self._template = bert.init_params(jax.random.key(args.seed), self.cfg)
+        self.params = self._put(self._template)
+        self.checkpoint_path: Optional[str] = None
+        self._seen_shapes: set = set()
+
+        metrics_ref = self.metrics
+
+        def _forward(params, batch):
+            # Python body only executes while tracing: this IS the retrace
+            # counter (jax.jit replays the compiled program otherwise)
+            metrics_ref.retraces.inc()
+            return bert.classify(params, self.cfg, batch, dtype=self.dtype,
+                                 deterministic=True,
+                                 attn_impl="xla" if args.attention_impl == "auto"
+                                 else args.attention_impl)
+
+        if mesh is not None:
+            from pdnlp_tpu.parallel.sharding import batch_sharding, replicated
+
+            self._jit_forward = jax.jit(
+                _forward,
+                in_shardings=(replicated(mesh),
+                              batch_sharding(mesh)),
+                out_shardings=replicated(mesh),
+            )
+        else:
+            self._jit_forward = jax.jit(_forward)
+
+    # ------------------------------------------------------------ params
+    def _put(self, host_params):
+        if self.mesh is not None:
+            from pdnlp_tpu.parallel.sharding import replicated
+
+            return jax.device_put(host_params, replicated(self.mesh))
+        return jax.device_put(host_params)
+
+    def load_checkpoint(self, path: str) -> None:
+        """Swap in a strategy checkpoint (shape-validated; cache survives).
+
+        ``ckpt.load_params`` validates every leaf shape against the model
+        template and raises a per-leaf ``ValueError`` on mismatch — all
+        before any device transfer, so a wrong ``--model`` fails fast with
+        one file parse (``ckpt.load_raw`` exists for template-free
+        inspection when the error message isn't enough).
+        """
+        self.params = self._put(ckpt.load_params(path, self._template))
+        self.checkpoint_path = path
+
+    # ----------------------------------------------------------- forward
+    def infer(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        """Fixed-shape batch -> host logits ``[rows, num_labels]`` (fp32).
+
+        Tracks the compiled-shape cache: key is the batch's
+        ``(seq_len, rows)``; a first-seen key is a miss (and will trace),
+        every later one a hit that replays the compiled program.
+        """
+        rows, seq = batch["input_ids"].shape
+        key = (int(seq), int(rows))
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+        fwd = {k: batch[k] for k in ("input_ids", "attention_mask",
+                                     "token_type_ids")}
+        if self.mesh is not None:
+            from pdnlp_tpu.parallel.sharding import batch_sharding
+
+            sh = batch_sharding(self.mesh)
+            fwd = {k: jax.make_array_from_process_local_data(sh, v)
+                   for k, v in fwd.items()}
+        logits = self._jit_forward(self.params, fwd)
+        return np.asarray(jax.device_get(logits))
+
+    def infer_ids(self, id_lists: Sequence[Sequence[int]], seq_len: int,
+                  rows: int = 0) -> np.ndarray:
+        """Ragged id-lists -> logits for the REAL rows only (filler dropped)."""
+        rows = self.pad_rows(max(rows, len(id_lists)))
+        batch = pad_ids_to_bucket(id_lists, seq_len, rows,
+                                  pad_id=self.tokenizer.pad_id)
+        return self.infer(batch)[: len(id_lists)]
+
+    def classify_texts(self, texts: Sequence[str],
+                       seq_len: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """(preds, logits) for a list of texts at one padded length —
+        the single-call surface ``predict_tpu.py`` uses (``seq_len`` defaults
+        to ``args.max_seq_len``, the exact legacy padding)."""
+        seq_len = seq_len or self.args.max_seq_len
+        ids = self.tokenizer.encode_ragged(texts, seq_len)
+        logits = self.infer_ids(ids, seq_len)
+        return np.argmax(logits, axis=-1), logits
+
+    # ------------------------------------------------------------ shapes
+    def pad_rows(self, n: int) -> int:
+        """Round a row count up to the mesh's data-axis multiple."""
+        m = self.rows_multiple
+        return max(m, ((n + m - 1) // m) * m)
+
+    def warmup(self, buckets: Sequence[int], rows: int) -> None:
+        """Pre-trace one dummy batch per bucket so live traffic never
+        compiles.  The warmup calls count as the cache's misses; everything
+        after is expected to hit."""
+        rows = self.pad_rows(rows)
+        for seq in buckets:
+            self.infer_ids([[self.tokenizer.cls_id, self.tokenizer.sep_id]],
+                           seq, rows)

@@ -1,0 +1,71 @@
+"""Serving observability: one object the engine/batcher/offline paths share.
+
+Built from the primitives in ``pdnlp_tpu.utils.metrics`` (Counter / Gauge /
+Histogram).  ``snapshot()`` returns a plain-JSON dict in the same artifact
+style as the training results under ``results/`` — ``bench.py --serve``
+writes one as ``results/serve_smoke.json``.
+
+What each instrument answers:
+
+- ``request_latency_ms`` — end-to-end submit->result time per request
+  (p50/p95/p99: the SLO numbers);
+- ``queue_wait_ms`` — how long requests sat before their batch flushed
+  (separates batching delay from compute);
+- ``queue_depth`` — instantaneous queued-request gauge (backpressure health);
+- ``batch_occupancy`` — real rows / padded rows per executed batch (how much
+  accelerator work is filler; 1.0 = perfectly packed);
+- ``cache_hits`` / ``cache_misses`` — engine compiled-shape cache: a miss is
+  the first call at a ``(bucket, rows)`` shape, a hit is every later one;
+- ``retraces`` — times the jitted forward actually re-traced; after warmup
+  this must stay FLAT (the acceptance bar for the serve smoke);
+- ``requests_total`` / ``rejected_total`` / ``deadline_expired_total`` —
+  admission accounting (rejects = backpressure, expiries = shed load).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict
+
+from pdnlp_tpu.utils.metrics import Counter, Gauge, Histogram
+
+
+class ServeMetrics:
+    def __init__(self) -> None:
+        self.request_latency_ms = Histogram()
+        self.queue_wait_ms = Histogram()
+        self.batch_occupancy = Histogram()
+        self.queue_depth = Gauge()
+        self.cache_hits = Counter()
+        self.cache_misses = Counter()
+        self.retraces = Counter()
+        self.requests_total = Counter()
+        self.rejected_total = Counter()
+        self.deadline_expired_total = Counter()
+        self.batches_total = Counter()
+
+    def snapshot(self) -> Dict:
+        """JSON-ready state of every instrument (plain floats/ints only)."""
+        return {
+            "requests_total": self.requests_total.value,
+            "rejected_total": self.rejected_total.value,
+            "deadline_expired_total": self.deadline_expired_total.value,
+            "batches_total": self.batches_total.value,
+            "queue_depth": self.queue_depth.value,
+            "request_latency_ms": self.request_latency_ms.snapshot(),
+            "queue_wait_ms": self.queue_wait_ms.snapshot(),
+            "batch_occupancy": self.batch_occupancy.snapshot(),
+            "compile_cache": {
+                "hits": self.cache_hits.value,
+                "misses": self.cache_misses.value,
+                "retraces": self.retraces.value,
+            },
+        }
+
+    def save(self, path: str) -> None:
+        """Atomic JSON dump (the ``results/`` artifact convention)."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f, indent=2)
+        os.replace(tmp, path)

@@ -112,6 +112,20 @@ def load(path: str, like) -> Any:
     return restored
 
 
+def load_raw(path: str) -> Any:
+    """Template-free restore: the checkpoint's raw pytree as host numpy.
+
+    The read-only half of :func:`load` for consumers that have no model
+    template yet — the serving engine peeks a checkpoint's leaf shapes to
+    fail fast on a model mismatch BEFORE paying device transfer, and the
+    ``serve_tpu.py`` CLI prints what a file contains.  Never use this to
+    feed a forward pass directly; :func:`load` (shape-validated against the
+    model template) is the loading path.
+    """
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
 def save_params(path: str, state: Dict[str, Any]) -> None:
     """Model-only checkpoint — the ``state_dict`` analog used by test/predict."""
     save(path, state["params"])

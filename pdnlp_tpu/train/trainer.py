@@ -410,13 +410,27 @@ class Trainer:
                 "y_true": y_true, "y_pred": y_pred}
 
     def dev(self, loader) -> Tuple[float, float]:
-        """(weighted mean loss, accuracy) over the dev set."""
+        """(weighted mean loss, accuracy) over the dev set.
+
+        STATIC-CONTENT REQUIREMENT: eval batches are cached on device keyed
+        by loader IDENTITY (``_evaluate``), so the loader must yield the
+        same batches on every iteration.  The shipped ``shuffle=False`` dev
+        loaders satisfy this; a shuffling or augmenting loader would be
+        silently evaluated on its FIRST iteration's frozen batches forever.
+        Pass such a loader under a fresh object per call (or a wrapper with
+        a new identity) to force re-upload.
+        """
         r = self._evaluate(loader, collect_preds=False)
         return r["loss"], r["accuracy"]
 
     def test(self, loader) -> Dict:
         """Eval + predictions: feeds the classification report
-        (``/root/reference/test.py:144-170``)."""
+        (``/root/reference/test.py:144-170``).
+
+        Shares ``dev()``'s device-side batch cache and therefore its
+        static-content requirement: the loader must yield identical batches
+        on every iteration (see :meth:`dev`).
+        """
         return self._evaluate(loader, collect_preds=True)
 
 

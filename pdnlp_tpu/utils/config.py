@@ -229,6 +229,21 @@ def enable_compilation_cache(args: "Args") -> None:
         pass  # never let cache plumbing break a training run
 
 
+def pop_cli_flag(argv, name: str, default=None, cast=str):
+    """``(argv_without_the_pair, value)`` for a script-local ``--name value``
+    flag that is NOT an ``Args`` field — shared by ``serve_tpu.py`` and
+    ``bench.py --serve`` so the extraction behavior can't drift.  The
+    returned argv is a new list; the input is not mutated."""
+    argv = list(argv)
+    if name in argv:
+        i = argv.index(name)
+        if i + 1 >= len(argv):
+            raise SystemExit(f"{name} requires a value")
+        value = cast(argv[i + 1])
+        return argv[:i] + argv[i + 2:], value
+    return argv, default
+
+
 def parse_cli(argv=None, base: Optional[Args] = None) -> Args:
     """``--key value`` CLI overrides onto an ``Args`` (argparse analog of
     ``multi-gpu-distributed-cls.py:374-381``)."""

@@ -1,15 +1,109 @@
-"""Per-class precision/recall/F1 — the ``sklearn.classification_report``
-analog used by the offline evaluator (``/root/reference/test.py:167``).
+"""Classification metrics + serving observability primitives.
 
-Implemented over numpy (no sklearn dependency on the TPU image); output
-format mirrors sklearn's text report so the judge can diff against the
-published reports (``/root/reference/README.md:464-479``).
+Two halves:
+
+- per-class precision/recall/F1 — the ``sklearn.classification_report``
+  analog used by the offline evaluator (``/root/reference/test.py:167``),
+  implemented over numpy (no sklearn dependency on the TPU image); output
+  format mirrors sklearn's text report so the judge can diff against the
+  published reports (``/root/reference/README.md:464-479``);
+- ``Counter`` / ``Gauge`` / ``Histogram`` — the observability primitives the
+  inference-serving subsystem (``pdnlp_tpu.serve``) aggregates into latency
+  p50/p95/p99, queue depth, batch occupancy and compile-cache counters, all
+  JSON-snapshot friendly so serve metrics land in ``results/`` next to the
+  training artifacts.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
+
+
+class Counter:
+    """Monotonic event count (thread-safe: batcher worker + submitters)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (e.g. queue depth)."""
+
+    def __init__(self) -> None:
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Streaming histogram with exact percentiles over a bounded window.
+
+    Keeps total count/sum/min/max exactly and the most recent ``window``
+    observations for percentile queries — a serving process alive for days
+    must not grow its latency record without bound, and recent-window
+    percentiles are what a dashboard wants anyway.  Thread-safe.
+    """
+
+    def __init__(self, window: int = 8192):
+        self._lock = threading.Lock()
+        self._window = int(window)
+        self._recent: List[float] = []
+        self._pos = 0  # ring-buffer cursor once the window is full
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self._recent) < self._window:
+                self._recent.append(v)
+            else:
+                self._recent[self._pos] = v
+                self._pos = (self._pos + 1) % self._window
+
+    def percentile(self, p: float) -> Optional[float]:
+        with self._lock:
+            if not self._recent:
+                return None
+            return float(np.percentile(np.asarray(self._recent), p))
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.total / self.count if self.count else None
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        """JSON-ready summary: count/mean/min/max + p50/p95/p99."""
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
 
 
 def per_class_stats(y_true: Sequence[int], y_pred: Sequence[int], num_classes: int):

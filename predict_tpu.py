@@ -13,16 +13,8 @@ from __future__ import annotations
 import os
 import random
 
-import jax
-import numpy as np
-
-from pdnlp_tpu.data import Collator, WordPieceTokenizer
 from pdnlp_tpu.data.corpus import id2label, load_data, split_data
-from pdnlp_tpu.data.tokenizer import get_or_build_vocab
-from pdnlp_tpu.models import bert
-from pdnlp_tpu.train import checkpoint as ckpt
-from pdnlp_tpu.train import setup_model
-from pdnlp_tpu.train.precision import resolve_dtype
+from pdnlp_tpu.serve import InferenceEngine
 from pdnlp_tpu.utils.config import Args, parse_cli
 from pdnlp_tpu.utils.logging import rank0_print
 from test_tpu import discover_checkpoints
@@ -38,31 +30,26 @@ def pick_sample(args: Args, want_label: int = 3):
 
 
 def main(args: Args, text=None, true_label=None):
-    tok = WordPieceTokenizer(get_or_build_vocab(args))
     if text is None:
         text, true_label = pick_sample(args)
     rank0_print(f"文本：{text}")
-    enc = tok.encode_batch([text], args.max_seq_len)
-    batch = {k: v for k, v in enc.items()}
 
-    cfg, _, state = setup_model(args, tok.vocab_size)
-    dtype = resolve_dtype(args.dtype)
-
-    @jax.jit
-    def forward(params, batch):
-        return bert.classify(params, cfg, batch, dtype=dtype, deterministic=True)
+    # One engine, N checkpoints: the serve-layer forward compiles ONCE
+    # (mesh=None = plain jit, the exact forward this script always ran —
+    # pad to max_seq_len, batch of one) and every checkpoint swap reuses
+    # the compiled program (engine cache keys on shape, not weights).
+    engine = InferenceEngine(args, mesh=None)
 
     preds = {}
     for path in discover_checkpoints(args.output_dir):
         name = os.path.relpath(path, args.output_dir)
         try:
-            loaded = ckpt.load_params(path, state["params"])
+            engine.load_checkpoint(path)
         except Exception as e:  # e.g. a checkpoint from a different --model
             rank0_print(f"{name}  skipped (incompatible with --model "
                         f"{args.model}): {type(e).__name__}: {e}")
             continue
-        params = jax.device_put(loaded)
-        pred = int(np.argmax(np.asarray(forward(params, batch)[0])))
+        pred = int(engine.classify_texts([text])[0][0])
         preds[name] = pred
         true_s = id2label.get(true_label, "?") if true_label is not None else "?"
         rank0_print(f"{name}  预测：{id2label[pred]}  真实：{true_s}")

@@ -107,10 +107,22 @@ def main():
     grid["tanh_b64_lr6e-05_ema0.99_1ep_eval24"] = dict(
         learning_rate=6e-5, ema_decay=0.99, epochs=1, eval_step=24, **tanh)
     # accept space- AND comma-separated name substrings (a comma list
-    # otherwise matches nothing and the run silently does no work)
+    # otherwise matches nothing and the run silently does no work); a token
+    # that exactly names a grid row selects ONLY that row — this grid has
+    # real substring-superset collisions ('b64_lr6e-05_ema0.99_3ep' is a
+    # substring of its 'tanh_...' sibling) that would silently re-run extra
+    # chip-time rows (same fix as scripts/bench_longcontext.py)
     only = [t for a in sys.argv[1:] for t in a.split(",") if t]
+
+    def selected(name):
+        if not only:
+            return True
+        if any(o == name for o in only):
+            return True
+        return any(o in name and o not in grid for o in only)
+
     for name, kw in grid.items():
-        if only and not any(o in name for o in only):
+        if not selected(name):
             continue
         if name in res["runs"] and res["runs"][name]:
             continue

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Long-lived inference server over a trained checkpoint.
+
+Turns a strategy checkpoint into a serving engine (``pdnlp_tpu.serve``):
+dynamic micro-batching, sequence-length bucketing, a compiled-forward cache
+that never retraces in steady state, and a JSON metrics snapshot on exit.
+
+Interactive (default): reads one UTF-8 text per line on stdin, prints
+``<label_id>\t<label>`` per line — the long-lived process a traffic frontend
+would own.  Offline: ``--input FILE`` scores a whole file at maximum
+throughput and writes predictions to ``--output`` (or stdout).
+
+    # online: serve stdin lines through the batcher
+    python serve_tpu.py --checkpoint output/dp-cls.msgpack
+
+    # offline: score a file, dump metrics
+    python serve_tpu.py --checkpoint output/dp-cls.msgpack \
+        --input texts.txt --output preds.tsv --metrics_path results/serve.json
+
+Serve-local flags (not ``Args`` fields): ``--checkpoint`` (default: newest
+under ``--output_dir``), ``--buckets 32,64,128``, ``--max_batch_size``,
+``--max_wait_ms``, ``--max_queue``, ``--deadline_ms``, ``--input``,
+``--output``, ``--metrics_path``, ``--no_mesh``.  Everything else (model,
+dtype, vocab, output_dir, ...) is the standard ``Args`` CLI.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+from pdnlp_tpu.serve import DEFAULT_BUCKETS, DynamicBatcher, InferenceEngine
+from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+from pdnlp_tpu.utils.logging import rank0_print
+
+
+def build_engine(args: Args, *, checkpoint: Optional[str] = None,
+                 use_mesh: bool = True) -> InferenceEngine:
+    """Engine over the standard mesh (or plain jit), checkpoint loaded.
+
+    ``checkpoint=None`` picks the newest ``.msgpack`` under
+    ``args.output_dir``; an engine with NO checkpoint (fresh init weights)
+    is only useful for smoke tests, so a missing checkpoint warns loudly.
+    """
+    mesh = None
+    if use_mesh:
+        from pdnlp_tpu.parallel import make_mesh
+
+        mesh = make_mesh(num_devices=args.num_devices, shape=args.mesh_shape)
+    engine = InferenceEngine(args, mesh=mesh)
+    if checkpoint is None:
+        from pdnlp_tpu.train import checkpoint as ckpt
+
+        checkpoint = ckpt.latest(args.output_dir)
+    if checkpoint:
+        engine.load_checkpoint(checkpoint)
+        rank0_print(f"serving {checkpoint}", file=sys.stderr)
+    else:
+        rank0_print("WARNING: no checkpoint found — serving untrained "
+                    "init weights (smoke mode)", file=sys.stderr)
+    return engine
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    argv, checkpoint = pop_cli_flag(argv, "--checkpoint")
+    argv, buckets_s = pop_cli_flag(argv, "--buckets")
+    argv, max_batch = pop_cli_flag(argv, "--max_batch_size", 8, int)
+    argv, max_wait = pop_cli_flag(argv, "--max_wait_ms", 5.0, float)
+    argv, max_queue = pop_cli_flag(argv, "--max_queue", 256, int)
+    argv, deadline = pop_cli_flag(argv, "--deadline_ms", None, float)
+    argv, in_path = pop_cli_flag(argv, "--input")
+    argv, out_path = pop_cli_flag(argv, "--output")
+    argv, metrics_path = pop_cli_flag(argv, "--metrics_path")
+    no_mesh = "--no_mesh" in argv
+    if no_mesh:
+        argv.remove("--no_mesh")
+    args = parse_cli(argv, base=Args())
+    buckets = (tuple(int(b) for b in buckets_s.split(",")) if buckets_s
+               else DEFAULT_BUCKETS)
+
+    from pdnlp_tpu.data.corpus import id2label
+
+    engine = build_engine(args, checkpoint=checkpoint, use_mesh=not no_mesh)
+
+    if in_path:  # offline: whole-file throughput path
+        from pdnlp_tpu.serve.offline import score_file
+
+        texts, preds, _ = score_file(engine, in_path, buckets=buckets,
+                                     batch_size=max_batch)
+        out = open(out_path, "w", encoding="utf-8") if out_path else sys.stdout
+        try:
+            for text, p in zip(texts, preds):
+                out.write(f"{int(p)}\t{id2label[int(p)]}\t{text}\n")
+        finally:
+            if out_path:
+                out.close()
+        rank0_print(f"scored {len(texts)} texts", file=sys.stderr)
+    else:  # online: stdin lines through the dynamic batcher
+        with DynamicBatcher(engine, buckets=buckets,
+                            max_batch_size=max_batch, max_wait_ms=max_wait,
+                            max_queue=max_queue,
+                            default_deadline_ms=deadline) as batcher:
+            # warmup over the batcher's OWN clamped bucket list: one
+            # definition of "usable" (batcher.usable_buckets), zero drift
+            engine.warmup(batcher.buckets, engine.pad_rows(max_batch))
+            rank0_print("ready — one text per line on stdin "
+                        "(EOF to exit)", file=sys.stderr)
+
+            # pipelined: keep a window of requests in flight so the batcher
+            # can actually form multi-row batches (submit-then-block per
+            # line would hold queue depth at 1 and micro-batching would
+            # never engage); results still print in input order
+            from collections import deque
+
+            window = 2 * batcher.max_batch_size
+            inflight: deque = deque()
+
+            def emit(fut) -> None:
+                try:
+                    logits = fut.result(timeout=60)
+                except Exception as e:  # noqa: BLE001 — QueueFullError,
+                    # DeadlineExceeded, engine failure: report, keep serving
+                    print(f"ERROR\t{type(e).__name__}: {e}", flush=True)
+                    return
+                p = int(logits.argmax())
+                print(f"{p}\t{id2label[p]}", flush=True)
+
+            for line in sys.stdin:
+                text = line.strip()
+                if not text:
+                    continue
+                try:
+                    inflight.append(batcher.submit(text))
+                except Exception as e:  # noqa: BLE001 — queue full: report
+                    print(f"ERROR\t{type(e).__name__}: {e}", flush=True)
+                    continue
+                while len(inflight) >= window:
+                    emit(inflight.popleft())
+            while inflight:
+                emit(inflight.popleft())
+
+    if metrics_path:
+        engine.metrics.save(metrics_path)
+        rank0_print(f"metrics snapshot -> {metrics_path}", file=sys.stderr)
+    else:
+        import json
+
+        rank0_print(json.dumps(engine.metrics.snapshot(), indent=2),
+                    file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

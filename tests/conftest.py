@@ -15,7 +15,12 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; the XLA_FLAGS
+    # host-platform override above already forces the 8 virtual devices
+    pass
 # NO persistent compile cache for the suite: XLA:CPU AOT cache entries
 # recorded with tuning pseudo-features (+prefer-no-gather/-scatter) abort
 # the interpreter when RELOADED in a later process on this host (observed
