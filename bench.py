@@ -186,20 +186,17 @@ def serve_smoke(argv) -> None:
 
 
 def main() -> None:
-    # A leaked PDNLP_GELU_TANH would force tanh on EVERY forward regardless
-    # of --gelu, while the pretrain cache below keys its artifact name on
-    # --gelu alone — a tanh trunk would silently land in the erf-named
-    # pretrained.msgpack and corrupt the provenance the activation-keyed
-    # cache exists to protect.  Refuse outright; the env override belongs
-    # to scripts/profile_step.py's A/B subprocesses only.
-    if os.environ.get("PDNLP_GELU_TANH", "0") == "1":
-        sys.exit("bench.py: PDNLP_GELU_TANH is set — this global activation "
-                 "override would desynchronize the activation-keyed pretrain "
-                 "cache (pretrained[-tanh].msgpack) from the weights actually "
-                 "produced.  Unset it and select the activation with --gelu.")
-
     argv = sys.argv[1:]
     if "--serve" in argv:
+        # No pretrain-cache key to fold a leaked PDNLP_GELU_TANH into here:
+        # serving would silently run tanh forwards over an erf-trained
+        # checkpoint and record mismatched parity numbers.  Refuse.
+        if os.environ.get("PDNLP_GELU_TANH", "0") == "1":
+            sys.exit("bench.py --serve: PDNLP_GELU_TANH is set — the global "
+                     "activation override would serve tanh forwards over a "
+                     "checkpoint trained with the configured activation. "
+                     "Unset it (the override belongs to "
+                     "scripts/profile_step.py's A/B subprocesses only).")
         argv.remove("--serve")
         return serve_smoke(argv)
 
@@ -239,6 +236,22 @@ def main() -> None:
         dev=True, eval_step=48,  # in-loop eval, keep best (reference ritual)
         log_every=10 ** 9,   # no per-step printing inside the timed loop
     ))
+
+    # A leaked PDNLP_GELU_TANH (scripts/profile_step.py's A/B subprocess
+    # override) force-enables tanh on EVERY forward regardless of --gelu,
+    # while the pretrain cache below keys its artifact name on args.gelu —
+    # a tanh trunk would silently land in the erf-named pretrained.msgpack
+    # and corrupt the provenance the activation-keyed cache exists to
+    # protect.  Fold the override into the key: the run IS tanh, so make
+    # args.gelu (and with it the cache suffix, the recorded config, and
+    # the warm-start artifact) say so.
+    if os.environ.get("PDNLP_GELU_TANH", "0") == "1" and \
+            (args.gelu or "erf") != "tanh":
+        print("bench.py: PDNLP_GELU_TANH=1 leaked into this run — every "
+              f"forward computes tanh GELU regardless of --gelu {args.gelu!r}"
+              ". Folding it into the config: this run is keyed/cached as "
+              "gelu=tanh (pretrained-tanh.msgpack).", file=sys.stderr)
+        args = args.replace(gelu="tanh")
 
     with contextlib.redirect_stdout(sys.stderr):
         import numpy as np

@@ -1,0 +1,30 @@
+"""pdnlp_tpu.analysis — jaxlint, the JAX/TPU tracing-hazard static analyzer.
+
+Pure ``ast`` (no jax import anywhere in the package): the rules catch the
+hazard classes that burned this repo before they burn TPU hours —
+
+===  =============================  ==========================================
+id   name                           hazard
+===  =============================  ==========================================
+R1   host-sync-in-jit               ``.item()``/``float()``/``np.asarray``/
+                                    ``jax.device_get`` inside traced code
+R2   traced-python-branch           ``if``/``while``/``assert`` on traced
+                                    values (ConcretizationTypeError/retrace)
+R3   prng-key-reuse                 same key consumed twice without a split
+R4   unblocked-async-timing         timer deltas around dispatched work with
+                                    no completion barrier
+R5   train-step-missing-donate      train-step-shaped jit without
+                                    ``donate_argnums`` (transient 2x HBM)
+R6   unknown-partition-axis         ``PartitionSpec`` axis no mesh declares
+===  =============================  ==========================================
+
+CLI: ``python lint_tpu.py`` (or ``python -m pdnlp_tpu.analysis``); library:
+:func:`analyze_paths`.  Inline suppressions: ``# jaxlint: disable=R1[,R2]``.
+The committed ``results/jaxlint_baseline.json`` ratchets tier-1 via
+``tests/test_jaxlint.py``: only NEW violations fail.
+"""
+from pdnlp_tpu.analysis.core import (  # noqa: F401
+    Finding, ModuleInfo, Rule, all_rules, parse_module, register, run_rules,
+)
+from pdnlp_tpu.analysis.cli import analyze_paths, default_paths, main  # noqa: F401
+from pdnlp_tpu.analysis import baseline  # noqa: F401

@@ -1,0 +1,7 @@
+"""``python -m pdnlp_tpu.analysis`` — same CLI as ``lint_tpu.py``."""
+import sys
+
+from pdnlp_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,477 @@
+"""jaxlint core — findings, per-module AST context, and the rule registry.
+
+The analyzer is pure ``ast``: it never imports jax (or the scanned modules),
+so it runs in milliseconds under any interpreter the repo's tooling uses —
+including CI images where the TPU plugin would make ``import jax`` either
+slow or fatal.  Every rule works from the same :class:`ModuleInfo` view of a
+file: source lines, the parsed tree, an import-alias map that canonicalizes
+``jnp.asarray`` -> ``jax.numpy.asarray``, and the set of function bodies that
+execute *under trace* (jit/shard_map/vmap/grad/scan and friends).
+
+Rules are small classes registered with :func:`register`; ``lint_tpu.py``
+discovers them through :func:`all_rules`.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+# --------------------------------------------------------------------- finding
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at ``path:line``."""
+
+    rule_id: str
+    path: str          # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    hint: str          # suggested rewrite (--fix-hints / JSON output)
+    snippet: str = ""  # stripped source line, for human output
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> Dict:
+        return {
+            "rule": self.rule_id,
+            "file": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "hint": self.hint,
+        }
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule_id)
+
+
+# ---------------------------------------------------------------- suppressions
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+class Suppressions:
+    """Inline ``# jaxlint: disable=R1[,R2]`` (or ``disable=all``) markers.
+
+    A marker on a code line suppresses that line; a marker on a
+    comment-only line suppresses the next line (so a hint can sit above a
+    long expression).
+    """
+
+    def __init__(self, source_lines: List[str]):
+        self._by_line: Dict[int, Set[str]] = {}
+        for i, text in enumerate(source_lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {t.strip().upper() for t in m.group(1).split(",") if t.strip()}
+            self._by_line.setdefault(i, set()).update(rules)
+            if text.lstrip().startswith("#"):  # comment-only: covers next line
+                self._by_line.setdefault(i + 1, set()).update(rules)
+
+    def is_suppressed(self, line: int, rule_id: str) -> bool:
+        rules = self._by_line.get(line)
+        return bool(rules) and (rule_id.upper() in rules or "ALL" in rules)
+
+
+# ------------------------------------------------------------------- the tree
+
+#: transforms whose function argument runs under trace — bodies of these
+#: functions must obey the same hazards as an explicit ``@jax.jit``
+TRACED_TRANSFORMS = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.map", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.cond", "jax.lax.switch",
+}
+
+#: the jit family proper — what R5 (donation) cares about
+JIT_TRANSFORMS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+SHARD_MAP_TRANSFORMS = {"jax.shard_map", "jax.experimental.shard_map.shard_map"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleInfo:
+    """Everything the rules need to know about one file, computed once."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.suppressions = Suppressions(self.lines)
+        self.aliases = self._collect_aliases(tree)
+        self._traced: Optional[Set[ast.AST]] = None
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    # ------------------------------------------------------------- imports
+    @staticmethod
+    def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted path of a Name/Attribute, through import aliases.
+
+        ``jnp.asarray`` -> ``jax.numpy.asarray`` (after ``import jax.numpy as
+        jnp``); a name with no alias resolves to itself.
+        """
+        dn = dotted_name(node)
+        if dn is None:
+            return None
+        head, _, rest = dn.partition(".")
+        head = self.aliases.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+    def resolves_to(self, node: ast.AST, targets: Set[str]) -> bool:
+        r = self.resolve(node)
+        if r is None:
+            return False
+        if r in targets:
+            return True
+        # `np` vs `numpy`: normalize the conventional alias when the file
+        # used a bare `import np`-style name that we could not see imported
+        if r.startswith("np."):
+            return ("numpy." + r[3:]) in targets
+        return False
+
+    # ------------------------------------------------------------- parents
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    # ------------------------------------------------------- traced bodies
+    def traced_functions(self) -> Set[ast.AST]:
+        """FunctionDef / Lambda nodes whose bodies run under a JAX trace.
+
+        Detected structurally:
+        - ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators;
+        - a local function name passed to any :data:`TRACED_TRANSFORMS`
+          call (``jax.jit(step_fn)``, ``jax.shard_map(per_device, ...)``,
+          ``jax.lax.scan(step_fn, ...)``);
+        - a lambda passed to one of those calls;
+        - the function(s) *returned by* a local builder that is itself
+          passed to a transform (``jax.jit(build_train_step(...))`` marks
+          the ``train_step`` def that ``build_train_step`` returns) — the
+          repo's dominant idiom;
+        - any def nested inside an already-traced def.
+        """
+        if self._traced is not None:
+            return self._traced
+
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        traced: Set[ast.AST] = set()
+
+        def mark_returned_defs(builder: ast.AST) -> None:
+            """The builder idiom: mark local defs its return statements name."""
+            for n in ast.walk(builder):
+                if isinstance(n, ast.Return) and isinstance(n.value, ast.Name):
+                    for d in defs_by_name.get(n.value.id, []):
+                        traced.add(d)
+
+        def mark_func_arg(arg: ast.AST) -> None:
+            if isinstance(arg, ast.Lambda):
+                traced.add(arg)
+            elif isinstance(arg, ast.Name):
+                for d in defs_by_name.get(arg.id, []):
+                    traced.add(d)
+            elif isinstance(arg, ast.Call):
+                fn = arg.func
+                # one hop through shard_map/partial-style wrappers
+                if self.resolves_to(fn, TRACED_TRANSFORMS) and arg.args:
+                    mark_func_arg(arg.args[0])
+                else:
+                    name = dotted_name(fn)
+                    if name and "." not in name:
+                        for d in defs_by_name.get(name, []):
+                            mark_returned_defs(d)
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_traced_transform_expr(dec):
+                        traced.add(node)
+            elif isinstance(node, ast.Call):
+                if self.resolves_to(node.func, TRACED_TRANSFORMS) and node.args:
+                    mark_func_arg(node.args[0])
+
+        # nested defs inside a traced def are traced too
+        grew = True
+        while grew:
+            grew = False
+            for fn in list(traced):
+                for n in ast.walk(fn):
+                    if n is not fn and isinstance(
+                            n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)) and n not in traced:
+                        traced.add(n)
+                        grew = True
+
+        self._traced = traced
+        return traced
+
+    def _is_traced_transform_expr(self, dec: ast.AST) -> bool:
+        """Decorator forms: ``@jax.jit``, ``@jax.jit(...)``,
+        ``@partial(jax.jit, ...)`` / ``@functools.partial(jax.jit, ...)``."""
+        if self.resolves_to(dec, TRACED_TRANSFORMS):
+            return True
+        if isinstance(dec, ast.Call):
+            if self.resolves_to(dec.func, TRACED_TRANSFORMS):
+                return True
+            if self.resolve(dec.func) == "functools.partial" and dec.args:
+                return self.resolves_to(dec.args[0], TRACED_TRANSFORMS)
+        return False
+
+    # ---------------------------------------------------------- taint sets
+    STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                    "weak_type", "itemsize", "nbytes"}
+    STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type",
+                    "callable", "id", "repr", "str"}
+
+    def tainted_names(self, fn: ast.AST) -> Set[str]:
+        """Names inside ``fn`` that (transitively) hold traced values:
+        parameters, plus assignment targets whose RHS mentions a tainted
+        name *dynamically* (``x.shape`` / ``len(x)`` / ``x is None`` are
+        static under trace and do not propagate)."""
+        args = getattr(fn, "args", None)
+        tainted: Set[str] = set()
+        if args is not None:
+            for a in (list(args.posonlyargs) + list(args.args)
+                      + list(args.kwonlyargs)):
+                tainted.add(a.arg)
+            if args.vararg:
+                tainted.add(args.vararg.arg)
+            if args.kwarg:
+                tainted.add(args.kwarg.arg)
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        nested = {n for b in body for n in ast.walk(b)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)) and n is not fn}
+
+        def in_nested(node: ast.AST) -> bool:
+            p = self.parents.get(node)
+            while p is not None and p is not fn:
+                if p in nested:
+                    return True
+                p = self.parents.get(p)
+            return False
+
+        def targets_of(node: ast.AST) -> Iterator[str]:
+            if isinstance(node, ast.Name):
+                yield node.id
+            elif isinstance(node, (ast.Tuple, ast.List)):
+                for elt in node.elts:
+                    yield from targets_of(elt)
+            elif isinstance(node, ast.Starred):
+                yield from targets_of(node.value)
+
+        grew = True
+        while grew:
+            grew = False
+            for b in body:
+                for node in ast.walk(b):
+                    if in_nested(node):
+                        continue
+                    pairs: List[Tuple[Iterable[str], ast.AST]] = []
+                    if isinstance(node, ast.Assign):
+                        pairs = [(list(targets_of(t)), node.value)
+                                 for t in node.targets]
+                    elif isinstance(node, ast.AnnAssign) and node.value:
+                        pairs = [(list(targets_of(node.target)), node.value)]
+                    elif isinstance(node, ast.AugAssign):
+                        pairs = [(list(targets_of(node.target)), node.value)]
+                    elif isinstance(node, ast.NamedExpr):
+                        pairs = [(list(targets_of(node.target)), node.value)]
+                    elif isinstance(node, ast.For):
+                        pairs = [(list(targets_of(node.target)), node.iter)]
+                    for names, value in pairs:
+                        if self.mentions_traced(value, tainted):
+                            for n in names:
+                                if n not in tainted:
+                                    tainted.add(n)
+                                    grew = True
+        return tainted
+
+    def mentions_traced(self, expr: ast.AST, tainted: Set[str]) -> bool:
+        """True when evaluating ``expr`` touches a tainted value in a way
+        that forces concretization or carries tracedness — i.e. excluding
+        the trace-static reads (``.shape``/``.dtype``/``len``/``is None``/
+        dict membership)."""
+
+        def dyn(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in tainted
+            if isinstance(e, ast.Attribute):
+                if e.attr in self.STATIC_ATTRS:
+                    return False
+                return dyn(e.value)
+            if isinstance(e, ast.Subscript):
+                # x.shape[0] is static; x[0] is traced
+                return dyn(e.value) or dyn(e.slice)
+            if isinstance(e, ast.Call):
+                fname = dotted_name(e.func)
+                if fname in self.STATIC_CALLS:
+                    return False
+                parts = [dyn(a) for a in e.args]
+                parts += [dyn(k.value) for k in e.keywords if k.value]
+                if isinstance(e.func, ast.Attribute):
+                    parts.append(dyn(e.func.value))
+                return any(parts)
+            if isinstance(e, ast.Compare):
+                static_ops = (ast.Is, ast.IsNot, ast.In, ast.NotIn)
+                if all(isinstance(op, static_ops) for op in e.ops):
+                    return False
+                return any(dyn(c) for c in [e.left] + list(e.comparators))
+            if isinstance(e, (ast.BoolOp,)):
+                return any(dyn(v) for v in e.values)
+            if isinstance(e, ast.BinOp):
+                return dyn(e.left) or dyn(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return dyn(e.operand)
+            if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+                return any(dyn(v) for v in e.elts)
+            if isinstance(e, ast.Dict):
+                return any(dyn(v) for v in list(e.keys) + list(e.values)
+                           if v is not None)
+            if isinstance(e, ast.IfExp):
+                return dyn(e.test) or dyn(e.body) or dyn(e.orelse)
+            if isinstance(e, ast.Starred):
+                return dyn(e.value)
+            if isinstance(e, ast.JoinedStr):
+                return any(dyn(v.value) for v in e.values
+                           if isinstance(v, ast.FormattedValue))
+            return False
+
+        return dyn(expr)
+
+    # ----------------------------------------------------------- utilities
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def scopes(self) -> List[Tuple[str, ast.AST, List[ast.stmt]]]:
+        """(name, node, body) for the module plus every def — the statement
+        lists rules walk for ordered, per-scope analyses (R3/R4).  Nested
+        defs appear as their own scope and are excluded from the parent's
+        walk by the rules via the parents map."""
+        out: List[Tuple[str, ast.AST, List[ast.stmt]]] = [
+            ("<module>", self.tree, self.tree.body)]
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((node.name, node, node.body))
+            elif isinstance(node, ast.Lambda):
+                out.append(("<lambda>", node, [ast.Expr(node.body)]))
+        return out
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        p = self.parents.get(node)
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return p
+            p = self.parents.get(p)
+        return None
+
+
+def parse_module(path: str, display_path: str) -> Optional[ModuleInfo]:
+    """Parse one file; returns None (caller reports) on syntax errors."""
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    return ModuleInfo(display_path, source, tree)
+
+
+# -------------------------------------------------------------------- registry
+
+class Rule:
+    """Base class: subclasses set ``rule_id``/``name``/``hint`` and yield
+    :class:`Finding` from :meth:`check`."""
+
+    rule_id: str = ""
+    name: str = ""
+    #: one-line generic fix hint; rules may emit per-finding hints instead
+    hint: str = ""
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: ModuleInfo, node: ast.AST, message: str,
+                hint: Optional[str] = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(self.rule_id, mod.path, line, col, message,
+                       hint if hint is not None else self.hint,
+                       mod.snippet(line))
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and index a rule by its ``rule_id``."""
+    inst = cls()
+    if not inst.rule_id:
+        raise ValueError(f"{cls.__name__} has no rule_id")
+    _REGISTRY[inst.rule_id] = inst
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    # import side effect: rule modules self-register on first use
+    from pdnlp_tpu.analysis import rules  # noqa: F401
+    return dict(sorted(_REGISTRY.items()))
+
+
+def run_rules(mod: ModuleInfo, rule_ids: Optional[List[str]] = None
+              ) -> List[Finding]:
+    """All non-suppressed findings for one module, sorted by location."""
+    rules = all_rules()
+    if rule_ids:
+        rules = {rid: r for rid, r in rules.items() if rid in rule_ids}
+    findings: Set[Finding] = set()  # set: nested traced defs are walked from
+    for rule in rules.values():     # both scopes and would double-report
+        for f in rule.check(mod):
+            if not mod.suppressions.is_suppressed(f.line, f.rule_id):
+                findings.add(f)
+    return sorted(findings, key=Finding.sort_key)

@@ -1,0 +1,64 @@
+"""jaxlint output — text (human, grep-able) and JSON (machine) reporters."""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from pdnlp_tpu.analysis.core import Finding, all_rules
+
+
+def render_text(findings: List[Finding], new: Optional[List[Finding]] = None,
+                fix_hints: bool = False) -> str:
+    """``path:line:col: RID message`` per finding; new-vs-baseline ones are
+    marked, and ``--fix-hints`` appends the suggested rewrite."""
+    new_set = set(new or [])
+    out: List[str] = []
+    for f in findings:
+        mark = " [NEW]" if f in new_set else ""
+        out.append(f"{f.path}:{f.line}:{f.col + 1}: {f.rule_id}"
+                   f"{mark} {f.message}")
+        if f.snippet:
+            out.append(f"    | {f.snippet}")
+        if fix_hints and f.hint:
+            out.append(f"    fix: {f.hint}")
+    return "\n".join(out)
+
+
+def render_summary(findings: List[Finding], new: List[Finding],
+                   fixed: int, baseline_used: bool) -> str:
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    per = ", ".join(f"{rid}:{n}" for rid, n in sorted(by_rule.items()))
+    line = f"jaxlint: {len(findings)} finding(s)"
+    if per:
+        line += f" ({per})"
+    if baseline_used:
+        line += f"; {len(new)} new vs baseline"
+        if fixed:
+            line += (f", {fixed} fixed (regenerate with "
+                     "`python lint_tpu.py --write-baseline`)")
+    return line
+
+
+def render_json(findings: List[Finding], new: List[Finding], fixed: int,
+                baseline_used: bool) -> str:
+    return json.dumps({
+        "version": 1,
+        "summary": {
+            "total": len(findings),
+            "new": len(new),
+            "fixed_vs_baseline": fixed,
+            "baseline_used": baseline_used,
+        },
+        "findings": [f.to_dict() for f in findings],
+        "new_findings": [f.to_dict() for f in new],
+    }, indent=2)
+
+
+def render_rule_table() -> str:
+    """``--list-rules``: id, name, and the generic fix hint per rule."""
+    rows = [(r.rule_id, r.name, r.hint) for r in all_rules().values()]
+    width = max(len(n) for _, n, _ in rows)
+    return "\n".join(f"{rid}  {name:<{width}}  {hint}"
+                     for rid, name, hint in rows)

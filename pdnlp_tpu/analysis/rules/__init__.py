@@ -1,0 +1,13 @@
+"""jaxlint rules — importing this package registers every rule.
+
+One module per rule id keeps each hazard's heuristics (and their measured
+false-positive trade-offs, documented per module) independently editable.
+"""
+from pdnlp_tpu.analysis.rules import (  # noqa: F401
+    r1_host_sync,
+    r2_traced_branch,
+    r3_key_reuse,
+    r4_timing,
+    r5_donate,
+    r6_mesh_axes,
+)

@@ -1,0 +1,87 @@
+"""R1 — host-device sync points inside traced (jit) code.
+
+``.item()`` / ``float()`` / ``int()`` on a traced value, ``np.asarray`` /
+``np.array``, and ``jax.device_get`` all force the tracer to concretize:
+under ``jit`` they either raise ``ConcretizationTypeError`` at trace time or
+— worse, via callbacks or abstract-safe paths — silently serialize host and
+device every step.  The training loop's whole async-dispatch discipline
+(trainer.py fetches ONE loss per log line) exists to avoid exactly this.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pdnlp_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, dotted_name, register,
+)
+
+#: canonical call targets that materialize on host
+_HOST_CALLS = {
+    "jax.device_get": "return the value instead and fetch it outside the "
+                      "jitted function (jax.device_get at the call site)",
+    "numpy.asarray": "use jax.numpy.asarray inside traced code; convert on "
+                     "host only after the jitted call returns",
+    "numpy.array": "use jax.numpy.asarray inside traced code; convert on "
+                   "host only after the jitted call returns",
+}
+
+#: method calls on any object that concretize
+_HOST_METHODS = {
+    "item": "return the array and call .item() (or float()) on the host "
+            "after the jitted call",
+    "tolist": "return the array; .tolist() belongs on the host side",
+    "numpy": "return the array; .numpy()/np conversion belongs on the host",
+}
+
+
+@register
+class HostSyncInJit(Rule):
+    rule_id = "R1"
+    name = "host-sync-in-jit"
+    hint = "move the host conversion outside the traced function"
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        traced = mod.traced_functions()
+        for fn in traced:
+            tainted = mod.tainted_names(fn)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    yield from self._check_call(mod, fn, node, tainted)
+
+    def _check_call(self, mod, fn, node: ast.Call, tainted):
+        target = mod.resolve(node.func)
+        if target in _HOST_CALLS or (
+                target and target.startswith("np.")
+                and ("numpy." + target[3:]) in _HOST_CALLS):
+            canon = target if target in _HOST_CALLS else "numpy." + target[3:]
+            yield self.finding(
+                mod, node,
+                f"`{dotted_name(node.func)}` inside a jit-traced function "
+                "forces a host-device sync (or a tracer leak)",
+                _HOST_CALLS[canon])
+            return
+        # float(x) / int(x) on a traced value
+        if isinstance(node.func, ast.Name) and node.func.id in ("float", "int"):
+            if node.args and mod.mentions_traced(node.args[0], tainted):
+                yield self.finding(
+                    mod, node,
+                    f"`{node.func.id}()` on a traced value inside a "
+                    "jit-traced function raises ConcretizationTypeError "
+                    "(or syncs every step via callbacks)",
+                    "keep the value as a jax array; fetch with "
+                    "float(jax.device_get(x)) after the jitted call returns")
+            return
+        # x.item() / x.tolist() / x.numpy()
+        if isinstance(node.func, ast.Attribute) and not node.args \
+                and node.func.attr in _HOST_METHODS:
+            if mod.mentions_traced(node.func.value, tainted) \
+                    or isinstance(node.func.value, ast.Call):
+                yield self.finding(
+                    mod, node,
+                    f"`.{node.func.attr}()` inside a jit-traced function "
+                    "concretizes the tracer (host-device sync point)",
+                    _HOST_METHODS[node.func.attr])

@@ -1,0 +1,52 @@
+"""R2 — Python control flow branching on traced values.
+
+``if`` / ``while`` / ``assert`` on a traced value inside jit either raises
+``ConcretizationTypeError`` outright or — when the test happens to be
+concrete at trace time (a closure-captured array, a ``static_argnums``
+miss) — bakes ONE branch into the compiled program and silently re-traces
+whenever the value changes.  Trace-static reads (``x.shape``, ``x.ndim``,
+``len(x)``, ``x is None``, ``"k" in state``) are fine and not flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pdnlp_tpu.analysis.core import Finding, ModuleInfo, Rule, register
+
+_KIND = {ast.If: "if", ast.While: "while", ast.Assert: "assert"}
+
+_HINTS = {
+    "if": "use jax.lax.cond / jnp.where (or hoist the test to a static "
+          "argument)",
+    "while": "use jax.lax.while_loop (or jax.lax.fori_loop for a counted "
+             "loop)",
+    "assert": "use equinox-style runtime checks outside jit, or "
+              "jax.debug.check-like patterns; plain assert on a tracer "
+              "never fires on device",
+}
+
+
+@register
+class TracedBranch(Rule):
+    rule_id = "R2"
+    name = "traced-python-branch"
+    hint = "replace Python control flow with jax.lax primitives"
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn in mod.traced_functions():
+            tainted = mod.tainted_names(fn)
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    kind = _KIND.get(type(node))
+                    if kind is None:
+                        continue
+                    test = node.test
+                    if mod.mentions_traced(test, tainted):
+                        yield self.finding(
+                            mod, node,
+                            f"Python `{kind}` on a traced value inside a "
+                            "jit-traced function — ConcretizationTypeError "
+                            "or silent retrace/branch-baking hazard",
+                            _HINTS[kind])

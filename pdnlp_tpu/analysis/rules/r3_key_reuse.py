@@ -1,0 +1,143 @@
+"""R3 — PRNG key reuse.
+
+JAX PRNG keys are consumed functionally: passing the SAME key variable to
+two ``jax.random.*`` draws yields *identical* randomness — dropout masks
+that repeat every layer, initializations that alias, augmentations that
+stop augmenting.  The fix is always the same: ``jax.random.split`` (or
+``fold_in`` with distinct data) between uses.
+
+Heuristic: within one scope (module body or one function), the same bare
+name passed as the key argument to two consuming ``jax.random.*`` calls,
+with no reassignment of that name in between (statement order by line).
+Uses in mutually exclusive ``if``/``else`` arms never execute together and
+are not paired (pretrain.py's span/i.i.d. masking split is exactly that
+shape).  ``fold_in`` is not counted as a consumer — ``fold_in(key, step)``
+with varying data is the sanctioned per-step derivation idiom (trainer.py
+uses exactly that) — but two ``split`` calls on one key DO alias and are
+flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Tuple
+
+from pdnlp_tpu.analysis.core import Finding, ModuleInfo, Rule, register
+
+#: jax.random functions that do NOT consume a key's randomness
+_NON_CONSUMERS = {
+    "PRNGKey", "key", "key_data", "wrap_key_data", "key_impl", "fold_in",
+    "clone",
+}
+
+
+def _key_arg(call: ast.Call) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == "key":
+            return kw.value
+    return call.args[0] if call.args else None
+
+
+@register
+class KeyReuse(Rule):
+    rule_id = "R3"
+    name = "prng-key-reuse"
+    hint = ("split the key between uses: `k1, k2 = jax.random.split(key)` "
+            "(or derive per-use keys with `jax.random.fold_in(key, i)`)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for _, scope_node, body in mod.scopes():
+            yield from self._check_scope(mod, scope_node, body)
+
+    def _iter_own(self, mod: ModuleInfo, scope_node, body):
+        """Walk a scope's nodes, excluding nested function bodies (they are
+        their own scopes)."""
+        for stmt in body:
+            for node in ast.walk(stmt):
+                fn = mod.enclosing_function(node)
+                owner = scope_node if not isinstance(scope_node, ast.Module) \
+                    else None
+                if fn is owner or (owner is None and fn is None) \
+                        or node is scope_node:
+                    yield node
+
+    def _branch_path(self, mod: ModuleInfo, node: ast.AST, scope_node
+                     ) -> Dict[int, str]:
+        """{id(If): arm} for every enclosing if/else — two events pair only
+        when they can execute in the same run (same arm of every shared
+        if)."""
+        path: Dict[int, str] = {}
+        child, p = node, mod.parents.get(node)
+        while p is not None and p is not scope_node:
+            if isinstance(p, ast.If):
+                arm = "body" if any(child is s or _contains(s, child)
+                                    for s in p.body) else "orelse"
+                path[id(p)] = arm
+            child, p = p, mod.parents.get(p)
+        return path
+
+    def _check_scope(self, mod: ModuleInfo, scope_node, body
+                     ) -> Iterator[Finding]:
+        events: List[Tuple[int, int, str, str, ast.AST]] = []
+        for node in self._iter_own(mod, scope_node, body):
+            if isinstance(node, ast.Call):
+                target = mod.resolve(node.func) or ""
+                if target.startswith("jax.random.") \
+                        and target.rsplit(".", 1)[1] not in _NON_CONSUMERS:
+                    arg = _key_arg(node)
+                    if isinstance(arg, ast.Name):
+                        events.append((node.lineno, node.col_offset,
+                                       "use", arg.id, node))
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.For):
+                targets = [node.target]
+            elif isinstance(node, ast.NamedExpr):
+                targets = [node.target]
+            for t in targets:
+                for name in _names_in_target(t):
+                    events.append((node.lineno, getattr(node, "col_offset", 0),
+                                   "def", name, node))
+
+        # uses sort before defs on the same line: in `key = split(key)` the
+        # RHS consumes the OLD key, so a prior pending draw on `key` must be
+        # compared before the assignment clears it
+        events.sort(key=lambda e: (e[0], e[2] == "def", e[1]))
+        # key name -> [(line, branch path)] of pending uses
+        pending: Dict[str, List[Tuple[int, Dict[int, str]]]] = {}
+        for line, _col, kind, name, node in events:
+            if kind == "def":
+                pending.pop(name, None)
+                continue
+            path = self._branch_path(mod, node, scope_node)
+            hit = next((pl for pl, pp in pending.get(name, [])
+                        if _compatible(pp, path)), None)
+            if hit is not None:
+                yield self.finding(
+                    mod, node,
+                    f"PRNG key `{name}` reused: also consumed at line "
+                    f"{hit} with no split/reassignment in between "
+                    "— both draws return IDENTICAL randomness",
+                )
+            pending.setdefault(name, []).append((line, path))
+
+
+def _contains(tree: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(tree))
+
+
+def _compatible(p1: Dict[int, str], p2: Dict[int, str]) -> bool:
+    """Two branch paths can co-execute: same arm of every SHARED if."""
+    return all(p2[k] == v for k, v in p1.items() if k in p2)
+
+
+def _names_in_target(node: ast.AST):
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _names_in_target(elt)
+    elif isinstance(node, ast.Starred):
+        yield from _names_in_target(node.value)

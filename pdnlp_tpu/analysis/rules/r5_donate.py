@@ -1,0 +1,118 @@
+"""R5 — train-step-shaped jits without buffer donation.
+
+A train step rebuilds the whole state every call; without
+``donate_argnums=0`` XLA must keep the input params/opt-state alive while
+writing the outputs, transiently DOUBLING the state's HBM footprint — the
+difference between a config that trains and one that OOMs at scale (every
+train-step jit in this repo donates for exactly that reason; eval steps
+must NOT donate, their params are reused next call).
+
+Heuristic: a ``jax.jit(...)`` application (call or decorator form) whose
+target function is *step-shaped* — its name (or the name of the builder
+that returns it, stripped of ``build_``/``make_`` prefixes) says
+train/update/step, or its first parameter is ``state``-like — and whose
+keywords include no ``donate_argnums``/``donate_argnames``.  Names that say
+eval/test/dev/predict/infer/init/forward/loss are exempt.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from pdnlp_tpu.analysis.core import (
+    Finding, JIT_TRANSFORMS, ModuleInfo, Rule, SHARD_MAP_TRANSFORMS,
+    dotted_name, register,
+)
+
+#: strong name evidence: train/update/multi steps and any `*_step` that the
+#: exempt list did not claim.  A GENERIC `step`/`step_fn` name is not
+#: enough by itself — it needs a state-like first parameter.
+_STEP_RE = re.compile(r"(train|multi|update)_?step|_step$|^update(_fn)?$")
+_EXEMPT_RE = re.compile(r"eval|test|dev|predict|infer|init|forward|loss"
+                        r"|valid|score")
+_STATE_PARAMS = {"state", "train_state", "carry", "opt_state"}
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames", "donate"}
+
+
+@register
+class MissingDonate(Rule):
+    rule_id = "R5"
+    name = "train-step-missing-donate"
+    hint = ("pass donate_argnums=0 so XLA reuses the input state buffers "
+            "in place of doubling HBM for one step")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        defs = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+
+        for node in ast.walk(mod.tree):
+            # call form: step = jax.jit(fn, ...)
+            if isinstance(node, ast.Call) \
+                    and mod.resolves_to(node.func, JIT_TRANSFORMS):
+                if any(kw.arg in _DONATE_KWARGS for kw in node.keywords
+                       if kw.arg):
+                    continue
+                cand = self._candidate_name(mod, node.args[0], defs) \
+                    if node.args else None
+                if cand and self._step_shaped(cand, defs):
+                    yield self.finding(
+                        mod, node,
+                        f"jit of train-step-shaped `{cand}` without "
+                        "donate_argnums — the input state stays live and "
+                        "the step transiently doubles its HBM footprint")
+            # decorator form: @jax.jit / @partial(jax.jit, ...)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._jit_decorator_without_donate(mod, dec) \
+                            and self._step_shaped(node.name, defs):
+                        yield self.finding(
+                            mod, dec,
+                            f"@jit on train-step-shaped `{node.name}` "
+                            "without donate_argnums — the input state stays "
+                            "live and the step transiently doubles its HBM "
+                            "footprint")
+
+    def _jit_decorator_without_donate(self, mod: ModuleInfo,
+                                      dec: ast.AST) -> bool:
+        if mod.resolves_to(dec, JIT_TRANSFORMS):
+            return True  # bare @jax.jit: no kwargs at all
+        if isinstance(dec, ast.Call):
+            is_jit = mod.resolves_to(dec.func, JIT_TRANSFORMS) or (
+                mod.resolve(dec.func) == "functools.partial" and dec.args
+                and mod.resolves_to(dec.args[0], JIT_TRANSFORMS))
+            if is_jit:
+                return not any(kw.arg in _DONATE_KWARGS
+                               for kw in dec.keywords if kw.arg)
+        return False
+
+    def _candidate_name(self, mod: ModuleInfo, arg: ast.AST, defs
+                        ) -> Optional[str]:
+        if isinstance(arg, ast.Name):
+            return arg.id
+        if isinstance(arg, ast.Lambda):
+            a = arg.args.args
+            return a[0].arg if a else None  # judge by first-param name
+        if isinstance(arg, ast.Call):
+            # through shard_map: judge the mapped function itself
+            if mod.resolves_to(arg.func, SHARD_MAP_TRANSFORMS) and arg.args:
+                return self._candidate_name(mod, arg.args[0], defs)
+            name = dotted_name(arg.func)
+            if name and "." not in name:
+                # builder idiom: build_train_step(...) makes a train step
+                return re.sub(r"^(build|make)_", "", name)
+        return None
+
+    def _step_shaped(self, cand: str, defs) -> bool:
+        low = cand.lower()
+        if _EXEMPT_RE.search(low):
+            return False
+        if _STEP_RE.search(low):
+            return True
+        d = defs.get(cand)
+        if d is not None and d.args.args:
+            first = d.args.args[0].arg
+            return first in _STATE_PARAMS and not _EXEMPT_RE.search(d.name)
+        return low in _STATE_PARAMS  # lambda judged by first param

@@ -1,0 +1,98 @@
+"""R6 — PartitionSpec axis names no mesh declares.
+
+``with_sharding_constraint(x, P('modle'))`` with a typo'd axis doesn't
+error loudly in every path — under ``jit`` with an ambient mesh it can
+simply fail to constrain, silently degrading a sharded run to replicated
+(all the HBM, none of the parallelism).  The repo's canonical axis
+vocabulary lives in ``pdnlp_tpu/parallel/mesh.py`` (``KNOWN_AXES``); this
+rule parses it from there — by AST, never importing — and flags every
+string axis inside a ``PartitionSpec(...)`` / ``P(...)`` call that the
+vocabulary doesn't contain.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, Optional, Set
+
+from pdnlp_tpu.analysis.core import Finding, ModuleInfo, Rule, register
+
+#: fallback when mesh.py cannot be parsed (e.g. analyzer vendored elsewhere)
+_DEFAULT_AXES = {"data", "model", "expert", "seq", "stage"}
+
+_MESH_PY = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "parallel", "mesh.py")
+
+
+def declared_axes(mesh_path: str = _MESH_PY) -> Set[str]:
+    """Axis names declared in mesh.py: every module-level UPPER_CASE
+    assignment of a string constant (``DATA_AXIS = "data"``), tuple
+    unpacking of string constants, and the ``KNOWN_AXES`` registry tuple."""
+    try:
+        with open(mesh_path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return set(_DEFAULT_AXES)
+    axes: Set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            names = [target] if isinstance(target, ast.Name) else (
+                list(target.elts) if isinstance(target, (ast.Tuple, ast.List))
+                else [])
+            if not all(isinstance(n, ast.Name) and n.id.isupper()
+                       for n in names) or not names:
+                continue
+            for v in ast.walk(node.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    axes.add(v.value)
+    return axes or set(_DEFAULT_AXES)
+
+
+@register
+class UnknownMeshAxis(Rule):
+    rule_id = "R6"
+    name = "unknown-partition-axis"
+    hint = ("use an axis declared in pdnlp_tpu/parallel/mesh.py KNOWN_AXES "
+            "(or add the new axis there so every subsystem agrees on it)")
+
+    def __init__(self):
+        self._axes: Optional[Set[str]] = None
+
+    @property
+    def axes(self) -> Set[str]:
+        if self._axes is None:
+            self._axes = declared_axes()
+        return self._axes
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        # only meaningful in files that actually import PartitionSpec —
+        # a random local helper named P() must not trip the rule
+        spec_aliases = {alias for alias, origin in mod.aliases.items()
+                        if origin.endswith("PartitionSpec")}
+        if not spec_aliases:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id in spec_aliases):
+                resolved = mod.resolve(node.func) or ""
+                if not resolved.endswith("PartitionSpec"):
+                    continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                yield from self._check_spec_entry(mod, arg)
+
+    def _check_spec_entry(self, mod: ModuleInfo, entry: ast.AST
+                          ) -> Iterator[Finding]:
+        values = entry.elts if isinstance(entry, (ast.Tuple, ast.List)) \
+            else [entry]
+        for v in values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str) \
+                    and v.value not in self.axes:
+                yield self.finding(
+                    mod, v,
+                    f"PartitionSpec axis '{v.value}' is not declared by any "
+                    "mesh (pdnlp_tpu/parallel/mesh.py KNOWN_AXES) — a typo "
+                    "here silently leaves the array unconstrained/replicated")

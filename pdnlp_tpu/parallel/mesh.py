@@ -18,6 +18,16 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 
+#: Every axis name any mesh in this repo may declare — the canonical
+#: vocabulary: "data" (all strategies), "model" (tensor parallel,
+#: sharding.MODEL_AXIS), "expert" (MoE expert parallel,
+#: sharding.EXPERT_AXIS), "seq" (sequence parallel, sp.SEQ), "stage"
+#: (pipeline parallel, pp.STAGE).  jaxlint rule R6 parses this tuple (by
+#: AST, never importing) and flags any PartitionSpec axis string outside
+#: it — a typo'd axis silently leaves an array unconstrained.  Add new
+#: axes HERE first.
+KNOWN_AXES = ("data", "model", "expert", "seq", "stage")
+
 
 def make_mesh(
     num_devices: Optional[int] = None,

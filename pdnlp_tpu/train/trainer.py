@@ -386,14 +386,22 @@ class Trainer:
                 self.best_accuracy = json.load(f)["best_accuracy"]
 
     # ------------------------------------------------------------------- eval
-    def _evaluate(self, loader, collect_preds: bool) -> Dict:
+    def _evaluate(self, loader, collect_preds: bool,
+                  static_eval: bool = True) -> Dict:
         # Dispatch the whole pass first, fetch once at the end: a per-batch
         # float() would serialize host and device through the dev set (the
         # train loop's async-dispatch treatment, applied to eval).
-        if self._eval_cache is None or self._eval_cache[0] is not loader:
-            self._eval_cache = (loader, [self.put(b) for b in loader])
+        if not static_eval:
+            # shuffling/augmenting loader: re-upload THIS iteration's
+            # batches and leave the identity-keyed cache untouched (a
+            # static loader used elsewhere keeps its device copy)
+            batches = [self.put(b) for b in loader]
+        else:
+            if self._eval_cache is None or self._eval_cache[0] is not loader:
+                self._eval_cache = (loader, [self.put(b) for b in loader])
+            batches = self._eval_cache[1]
         pending = [self.eval_step(self._eval_params(), batch)
-                   for batch in self._eval_cache[1]]
+                   for batch in batches]
         fetched = jax.device_get(pending)
         y_true, y_pred = [], []
         loss_sum = weight = correct = 0.0
@@ -409,29 +417,33 @@ class Trainer:
         return {"loss": loss_sum / weight, "accuracy": correct / weight,
                 "y_true": y_true, "y_pred": y_pred}
 
-    def dev(self, loader) -> Tuple[float, float]:
+    def dev(self, loader, static_eval: bool = True) -> Tuple[float, float]:
         """(weighted mean loss, accuracy) over the dev set.
 
-        STATIC-CONTENT REQUIREMENT: eval batches are cached on device keyed
-        by loader IDENTITY (``_evaluate``), so the loader must yield the
-        same batches on every iteration.  The shipped ``shuffle=False`` dev
-        loaders satisfy this; a shuffling or augmenting loader would be
-        silently evaluated on its FIRST iteration's frozen batches forever.
-        Pass such a loader under a fresh object per call (or a wrapper with
-        a new identity) to force re-upload.
+        ``static_eval=True`` (default) caches the eval batches on device
+        keyed by loader IDENTITY (``_evaluate``), so the loader must yield
+        the same batches on every iteration — the shipped ``shuffle=False``
+        dev loaders satisfy this, and the in-loop eval cadence then pays
+        upload transport once instead of per eval.  A shuffling or
+        augmenting loader would be silently evaluated on its FIRST
+        iteration's frozen batches forever: pass ``static_eval=False`` for
+        such loaders to re-upload fresh batches on every call (the cache,
+        if any, is left untouched).
         """
-        r = self._evaluate(loader, collect_preds=False)
+        r = self._evaluate(loader, collect_preds=False,
+                           static_eval=static_eval)
         return r["loss"], r["accuracy"]
 
-    def test(self, loader) -> Dict:
+    def test(self, loader, static_eval: bool = True) -> Dict:
         """Eval + predictions: feeds the classification report
         (``/root/reference/test.py:144-170``).
 
         Shares ``dev()``'s device-side batch cache and therefore its
         static-content requirement: the loader must yield identical batches
-        on every iteration (see :meth:`dev`).
+        on every iteration, unless ``static_eval=False`` (see :meth:`dev`).
         """
-        return self._evaluate(loader, collect_preds=True)
+        return self._evaluate(loader, collect_preds=True,
+                              static_eval=static_eval)
 
 
 def _shardings_of(state):

@@ -18,6 +18,10 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PATH = os.path.join(REPO, "results", "recipe_b64_sweep.json")
 
+sys.path.insert(0, REPO)
+
+from pdnlp_tpu.utils.sweeps import make_selected, parse_only  # noqa: E402
+
 CODE = r"""
 import json, sys, time
 spec = json.loads(sys.argv[1])
@@ -106,20 +110,11 @@ def main():
             learning_rate=lr, ema_decay=0.99, epochs=1, **tanh)
     grid["tanh_b64_lr6e-05_ema0.99_1ep_eval24"] = dict(
         learning_rate=6e-5, ema_decay=0.99, epochs=1, eval_step=24, **tanh)
-    # accept space- AND comma-separated name substrings (a comma list
-    # otherwise matches nothing and the run silently does no work); a token
-    # that exactly names a grid row selects ONLY that row — this grid has
-    # real substring-superset collisions ('b64_lr6e-05_ema0.99_3ep' is a
+    # exact-name row selection (pdnlp_tpu.utils.sweeps): this grid has real
+    # substring-superset collisions ('b64_lr6e-05_ema0.99_3ep' is a
     # substring of its 'tanh_...' sibling) that would silently re-run extra
-    # chip-time rows (same fix as scripts/bench_longcontext.py)
-    only = [t for a in sys.argv[1:] for t in a.split(",") if t]
-
-    def selected(name):
-        if not only:
-            return True
-        if any(o == name for o in only):
-            return True
-        return any(o in name and o not in grid for o in only)
+    # chip-time rows
+    selected = make_selected(parse_only(sys.argv[1:]), grid)
 
     for name, kw in grid.items():
         if not selected(name):

@@ -1,29 +1,49 @@
 #!/usr/bin/env python
-"""Fine-tune recipe sweep: lr x pretrain-checkpoint -> dev accuracy."""
+"""Fine-tune recipe sweep: lr x pretrain-checkpoint -> dev accuracy.
+
+Positional args select grid rows by name under the exact-name rule
+(``pdnlp_tpu.utils.sweeps``): ``pretrained_lr2e-5`` runs exactly one cell;
+``lr2e-5`` substring-selects that lr across every checkpoint.
+"""
+import itertools
 import os
+import re
 import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import itertools
-import re
+from pdnlp_tpu.utils.sweeps import make_selected, parse_only  # noqa: E402
 
 CKPTS = [c for c in ("output/pretrained.msgpack", "output/pretrained_r150.msgpack")
          if os.path.exists(c)]
 LRS = ["2e-5", "3e-5", "5e-5"]
 
-for ckpt, lr in itertools.product(CKPTS, LRS):
-    p = subprocess.run(
-        [sys.executable, "multi-tpu-jax-cls.py", "--dtype", "bfloat16",
-         "--init_from", ckpt, "--learning_rate", lr,
-         "--log_every", "1000000000", "--dev", "false",
-         "--ckpt_name", "sweep-tmp.msgpack"],
-        capture_output=True, text=True, timeout=600)
-    accs = re.findall(r"accuracy：([\d.]+)", p.stdout)
-    mins = re.findall(r"耗时：([\d.]+)", p.stdout)
-    print(f"{os.path.basename(ckpt):28s} lr={lr:6s} "
-          f"acc={accs[-1] if accs else 'FAIL'} min={mins[-1] if mins else '?'}",
-          flush=True)
-    if not accs:
-        print(p.stdout[-1500:], p.stderr[-1500:])
+
+def main():
+    grid = {}
+    for ckpt, lr in itertools.product(CKPTS, LRS):
+        stem = os.path.splitext(os.path.basename(ckpt))[0]
+        grid[f"{stem}_lr{lr}"] = (ckpt, lr)
+
+    selected = make_selected(parse_only(sys.argv[1:]), grid)
+    for name, (ckpt, lr) in grid.items():
+        if not selected(name):
+            continue
+        p = subprocess.run(
+            [sys.executable, "multi-tpu-jax-cls.py", "--dtype", "bfloat16",
+             "--init_from", ckpt, "--learning_rate", lr,
+             "--log_every", "1000000000", "--dev", "false",
+             "--ckpt_name", "sweep-tmp.msgpack"],
+            capture_output=True, text=True, timeout=600)
+        accs = re.findall(r"accuracy：([\d.]+)", p.stdout)
+        mins = re.findall(r"耗时：([\d.]+)", p.stdout)
+        print(f"{os.path.basename(ckpt):28s} lr={lr:6s} "
+              f"acc={accs[-1] if accs else 'FAIL'} "
+              f"min={mins[-1] if mins else '?'}", flush=True)
+        if not accs:
+            print(p.stdout[-1500:], p.stderr[-1500:])
+
+
+if __name__ == "__main__":
+    main()

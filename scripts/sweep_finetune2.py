@@ -1,11 +1,18 @@
 #!/usr/bin/env python
-"""Accuracy-vs-pretrain-compute sweep under the best-of-epoch protocol."""
+"""Accuracy-vs-pretrain-compute sweep under the best-of-epoch protocol.
+
+Positional args select rows by name under the exact-name rule
+(``pdnlp_tpu.utils.sweeps``): ``p15-e150`` runs exactly that checkpoint;
+``p30`` substring-selects the whole p30 family.
+"""
 import os
 import re
 import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pdnlp_tpu.utils.sweeps import make_selected, parse_only  # noqa: E402
 
 CKPTS = [
     ("p30-e50", "output/pretrained-e50.msgpack"),
@@ -15,17 +22,25 @@ CKPTS = [
     ("p15-e300", "output/pretrained.msgpack"),
 ]
 
-for name, ckpt in CKPTS:
-    if not os.path.exists(ckpt):
-        continue
-    p = subprocess.run(
-        [sys.executable, "multi-tpu-jax-cls.py", "--dtype", "bfloat16",
-         "--init_from", ckpt, "--dev", "true", "--eval_step", "50",
-         "--log_every", "1000000000", "--ckpt_name", "sweep-tmp.msgpack"],
-        capture_output=True, text=True, timeout=600)
-    best = re.findall(r"【best accuracy】 ([\d.]+)", p.stdout)
-    final = re.findall(r"accuracy：([\d.]+)", p.stdout)
-    print(f"{name:10s} best={best[-1] if best else 'FAIL'} "
-          f"final_test={final[-1] if final else '?'}", flush=True)
-    if not best:
-        print(p.stdout[-1200:], p.stderr[-1200:])
+
+def main():
+    grid = dict(CKPTS)
+    selected = make_selected(parse_only(sys.argv[1:]), grid)
+    for name, ckpt in CKPTS:
+        if not selected(name) or not os.path.exists(ckpt):
+            continue
+        p = subprocess.run(
+            [sys.executable, "multi-tpu-jax-cls.py", "--dtype", "bfloat16",
+             "--init_from", ckpt, "--dev", "true", "--eval_step", "50",
+             "--log_every", "1000000000", "--ckpt_name", "sweep-tmp.msgpack"],
+            capture_output=True, text=True, timeout=600)
+        best = re.findall(r"【best accuracy】 ([\d.]+)", p.stdout)
+        final = re.findall(r"accuracy：([\d.]+)", p.stdout)
+        print(f"{name:10s} best={best[-1] if best else 'FAIL'} "
+              f"final_test={final[-1] if final else '?'}", flush=True)
+        if not best:
+            print(p.stdout[-1200:], p.stderr[-1200:])
+
+
+if __name__ == "__main__":
+    main()

@@ -3,6 +3,10 @@
 
 Runs in-process (TPU) with the best pretrain checkpoint; prints best-of-epoch
 dev accuracy per recipe.
+
+Positional args select rows by name under the exact-name rule
+(``pdnlp_tpu.utils.sweeps``): ``cosine-3e-5`` runs exactly that recipe;
+``cosine`` substring-selects the family.
 """
 import os
 import sys
@@ -17,6 +21,7 @@ jax.config.update("jax_compilation_cache_dir", "output/xla_cache")
 from pdnlp_tpu.train.run import build_parallel_trainer
 from pdnlp_tpu.train.optim import build_optimizer
 from pdnlp_tpu.utils.config import Args
+from pdnlp_tpu.utils.sweeps import make_selected, parse_only
 
 CKPT = "output/pretrained_p30.msgpack"
 
@@ -49,12 +54,24 @@ def run(tag, **kw):
 
 TOTAL = 288
 
-run("baseline const 3e-5")
-run("warmup6%+cosine 3e-5", schedule_fn=optax.warmup_cosine_decay_schedule(
-    0.0, 3e-5, warmup_steps=17, decay_steps=TOTAL))
-run("warmup6%+cosine 5e-5", schedule_fn=optax.warmup_cosine_decay_schedule(
-    0.0, 5e-5, warmup_steps=17, decay_steps=TOTAL))
-run("warmup6%+linear 5e-5", schedule_fn=optax.join_schedules(
-    [optax.linear_schedule(0.0, 5e-5, 17),
-     optax.linear_schedule(5e-5, 0.0, TOTAL - 17)], [17]))
-run("2 epochs const 3e-5", epochs=2)
+
+def main():
+    grid = {
+        "baseline-const-3e-5": dict(),
+        "cosine-3e-5": dict(schedule_fn=optax.warmup_cosine_decay_schedule(
+            0.0, 3e-5, warmup_steps=17, decay_steps=TOTAL)),
+        "cosine-5e-5": dict(schedule_fn=optax.warmup_cosine_decay_schedule(
+            0.0, 5e-5, warmup_steps=17, decay_steps=TOTAL)),
+        "linear-5e-5": dict(schedule_fn=optax.join_schedules(
+            [optax.linear_schedule(0.0, 5e-5, 17),
+             optax.linear_schedule(5e-5, 0.0, TOTAL - 17)], [17])),
+        "2ep-const-3e-5": dict(epochs=2),
+    }
+    selected = make_selected(parse_only(sys.argv[1:]), grid)
+    for name, kw in grid.items():
+        if selected(name):
+            run(name, **kw)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,10 @@
 #!/usr/bin/env python
-"""Round 2: schedule x epochs combinations on the best pretrain ckpt."""
+"""Round 2: schedule x epochs combinations on the best pretrain ckpt.
+
+Positional args select rows by name under the exact-name rule
+(``pdnlp_tpu.utils.sweeps``): ``2ep-wl-5e-5`` runs exactly that cell;
+``wl`` substring-selects every warmup-linear row.
+"""
 import os
 import sys
 
@@ -11,6 +16,7 @@ jax.config.update("jax_compilation_cache_dir", "output/xla_cache")
 
 from pdnlp_tpu.train.run import build_parallel_trainer
 from pdnlp_tpu.utils.config import Args
+from pdnlp_tpu.utils.sweeps import make_selected, parse_only
 
 CKPT = "output/pretrained_p30.msgpack"
 
@@ -48,8 +54,19 @@ def wl(peak, total):
                               learning_rate=peak), total)
 
 
-run("2ep warmup+linear 5e-5", schedule_fn=wl(5e-5, 576), epochs=2)
-run("2ep warmup+linear 3e-5", schedule_fn=wl(3e-5, 576), epochs=2)
-run("3ep warmup+linear 5e-5", schedule_fn=wl(5e-5, 864), epochs=3)
-run("3ep const 3e-5", epochs=3)
-run("2ep const 5e-5", learning_rate=5e-5, epochs=2)
+def main():
+    grid = {
+        "2ep-wl-5e-5": dict(schedule_fn=wl(5e-5, 576), epochs=2),
+        "2ep-wl-3e-5": dict(schedule_fn=wl(3e-5, 576), epochs=2),
+        "3ep-wl-5e-5": dict(schedule_fn=wl(5e-5, 864), epochs=3),
+        "3ep-const-3e-5": dict(epochs=3),
+        "2ep-const-5e-5": dict(learning_rate=5e-5, epochs=2),
+    }
+    selected = make_selected(parse_only(sys.argv[1:]), grid)
+    for name, kw in grid.items():
+        if selected(name):
+            run(name, **kw)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""R1 negative: the same conversions on the HOST side are the sanctioned
+idiom (fetch once, after the jitted call returns)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    return jnp.asarray(x) + 1.0    # jnp, not np: stays on device
+
+
+def host_loop(xs):
+    out = [step(x) for x in xs]
+    fetched = jax.device_get(out)          # host side: fine
+    total = float(np.asarray(fetched).sum())  # host side: fine
+    return total
+
+
+@jax.jit
+def closure_scalar(x, lr=0.1):
+    scale = float(3)               # constant, not a traced value
+    return x * scale * lr

@@ -1,0 +1,23 @@
+"""R1 positive: host syncs inside traced code (never executed, AST only)."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def loss_to_float(x):
+    return float(x.sum())          # line 8: float() on traced value
+
+
+@jax.jit
+def to_numpy(x):
+    return np.asarray(x) * 2.0     # line 13: np.asarray under trace
+
+
+@jax.jit
+def fetch(x):
+    return jax.device_get(x)       # line 18: device_get under trace
+
+
+@jax.jit
+def item_call(x):
+    return x.item()                # line 23: .item() under trace

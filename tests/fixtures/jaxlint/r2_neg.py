@@ -1,0 +1,29 @@
+"""R2 negative: trace-STATIC tests are fine under jit — shapes, dtypes,
+None-ness, dict membership, and closure config are all concrete at trace
+time and never concretize a tracer."""
+import jax
+
+
+@jax.jit
+def static_tests(state, batch, cfg_flag=True):
+    if batch["ids"].shape[0] > 8:      # shape: static
+        pass
+    if batch["ids"].ndim == 2:         # ndim: static
+        pass
+    if "ema" in state:                 # dict membership: static
+        pass
+    rng = state.get("rng")
+    if rng is None:                    # identity vs None: static
+        pass
+    if len(state) == 4:                # len: static
+        pass
+    assert isinstance(state, dict)     # isinstance: static
+    return state
+
+
+def host_branching(loader, threshold):
+    # not traced at all: plain Python may branch on anything
+    for batch in loader:
+        if batch["loss"] > threshold:
+            return batch
+    return None

@@ -1,0 +1,30 @@
+"""R2 positive: Python control flow on traced values."""
+import jax
+
+
+@jax.jit
+def branch_on_value(x):
+    if x.sum() > 0:                # line 7: if on traced value
+        return x
+    return -x
+
+
+@jax.jit
+def loop_on_value(x):
+    while x.mean() < 1.0:          # line 14: while on traced value
+        x = x * 2.0
+    return x
+
+
+@jax.jit
+def assert_on_value(x):
+    assert x.min() >= 0            # line 21: assert on traced value
+    return x
+
+
+@jax.jit
+def derived_taint(x):
+    y = x * 2                      # taint propagates through y
+    if y[0] > 1:                   # line 28: if on derived traced value
+        return y
+    return x

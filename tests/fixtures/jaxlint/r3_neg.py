@@ -1,0 +1,32 @@
+"""R3 negative: the sanctioned key-hygiene idioms."""
+import jax
+
+
+def split_between(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a + b
+
+
+def reassign_between(key):
+    a = jax.random.normal(key, (4,))
+    key = jax.random.fold_in(key, 1)    # fold_in derives; reassignment resets
+    b = jax.random.uniform(key, (4,))
+    return a + b
+
+
+def fold_in_per_step(state):
+    # trainer.py's idiom: fold_in with varying data is NOT a reuse
+    r1 = jax.random.fold_in(state["rng"], 0)
+    r2 = jax.random.fold_in(state["rng"], 1)
+    return r1, r2
+
+
+def exclusive_branches(key, span):
+    # two uses that never co-execute (pretrain.py's masking shape)
+    if span:
+        sel = jax.random.bernoulli(key, 0.5, (4,))
+    else:
+        sel = jax.random.uniform(key, (4,)) < 0.5
+    return sel

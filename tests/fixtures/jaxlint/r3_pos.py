@@ -1,0 +1,20 @@
+"""R3 positive: the same PRNG key consumed twice."""
+import jax
+
+
+def double_draw(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))   # line 7: same key, second draw
+    return a + b
+
+
+def double_split(key):
+    k1, k2 = jax.random.split(key)
+    k3, k4 = jax.random.split(key)      # line 13: split(key) twice aliases
+    return k1, k2, k3, k4
+
+
+def draw_then_split(key):
+    noise = jax.random.normal(key, (2,))
+    sub = jax.random.split(key, 2)      # line 19: key already consumed
+    return noise, sub

@@ -1,0 +1,25 @@
+"""R4 negative: barriered timing windows, and timer math with no dispatch."""
+import time
+
+import jax
+
+
+def time_steps_blocked(step, state, batch):
+    t0 = time.perf_counter()
+    for _ in range(10):
+        state, m = step(state, batch)
+    jax.block_until_ready(state)        # completion barrier inside window
+    return time.perf_counter() - t0
+
+
+def time_steps_fetch(step, state, batch):
+    t0 = time.time()
+    state, m = step(state, batch)
+    loss = float(jax.device_get(m["loss"]))  # value fetch = barrier
+    return time.time() - t0, loss
+
+
+def empty_window():
+    t0 = time.monotonic()
+    x = 1 + 2                           # no calls dispatched at all
+    return time.monotonic() - t0, x

@@ -1,0 +1,31 @@
+"""R5 negative: donated train steps, and eval steps (which must NOT
+donate — their params are reused on the next call)."""
+import functools
+
+import jax
+
+
+def train_step(state, batch):
+    return state, {}
+
+
+def eval_step(params, batch):
+    return {}
+
+
+jitted = jax.jit(train_step, donate_argnums=0)       # donated: fine
+jitted_names = jax.jit(train_step, donate_argnames="state")
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def multi_step(state, batches):
+    return state, {}
+
+
+jitted_eval = jax.jit(eval_step)      # eval: donation would be a bug
+
+
+def make_eval(cfg):
+    def dev_eval_step(params, batch):
+        return {}
+    return jax.jit(dev_eval_step)     # eval through a builder: fine

@@ -1,0 +1,27 @@
+"""R5 positive: train-step-shaped jits that forget buffer donation."""
+import functools
+
+import jax
+
+
+def train_step(state, batch):
+    return state, {}
+
+
+jitted = jax.jit(train_step)            # line 11: call form, no donate
+
+
+def make_step(cfg):
+    def update_step(state, batch):
+        return state, {}
+    return jax.jit(update_step)         # line 17: builder-local, no donate
+
+
+@jax.jit                                 # line 20: decorator form, no donate
+def multi_step(state, batches):
+    return state, {}
+
+
+@functools.partial(jax.jit, static_argnums=2)   # line 25: partial, no donate
+def fused_train_step(state, batch, k):
+    return state, {}

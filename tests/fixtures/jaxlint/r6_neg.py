@@ -1,0 +1,10 @@
+"""R6 negative: declared axes, None entries, and non-PartitionSpec P()s."""
+from jax.sharding import PartitionSpec as P
+
+SPEC_DATA = P("data")
+SPEC_2D = P("data", "model")
+SPEC_NESTED = P(("data", "expert"), None)
+SPEC_SP = P("data", "seq")
+SPEC_PP = P("stage")
+SPEC_REPL = P()
+SPEC_NONE = P(None, None)

@@ -1,0 +1,12 @@
+"""R6 positive: PartitionSpec axis names no mesh declares."""
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+SPEC_TYPO = P("data", "modle")                 # line 4: 'modle' typo
+SPEC_UNKNOWN = P(None, "tensor")               # line 5: 'tensor' undeclared
+
+
+def constrain(x, mesh):
+    import jax
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(("data", "batch"), None)))  # line 12: 'batch'

@@ -1,0 +1,23 @@
+"""Suppression fixture: inline markers silence exactly the named rule."""
+import jax
+
+
+@jax.jit
+def silenced(x):
+    return float(x.sum())  # jaxlint: disable=R1
+
+
+@jax.jit
+def silenced_by_comment_line(x):
+    # jaxlint: disable=R1 — hint comment on its own line covers the next
+    return float(x.sum())
+
+
+@jax.jit
+def wrong_id_still_fires(x):
+    return float(x.sum())  # jaxlint: disable=R2  (wrong rule: R1 at line 18)
+
+
+@jax.jit
+def disable_all(x):
+    return float(x.sum())  # jaxlint: disable=all

@@ -1,0 +1,217 @@
+"""jaxlint tier-1 suite: per-rule fixtures, suppressions, and the ratchet.
+
+The analyzer is pure ``ast`` (no jax import), so these tests are
+millisecond-fast and run anywhere.  The final test IS the CI ratchet: it
+scans the repo's real hazard surface against the committed baseline and
+fails only on NEW violations — the same check
+``python lint_tpu.py`` performs, wired into tier-1.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pdnlp_tpu.analysis import analyze_paths, baseline, default_paths  # noqa: E402
+from pdnlp_tpu.analysis.core import all_rules  # noqa: E402
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "jaxlint")
+
+
+def hits(name, rule_id=None):
+    """(rule_id, line) findings for one fixture file."""
+    path = os.path.join(FIXTURES, name)
+    found = analyze_paths([path], root=REPO)
+    if rule_id:
+        found = [f for f in found if f.rule_id == rule_id]
+    return [(f.rule_id, f.line) for f in found]
+
+
+def all_hits(name):
+    path = os.path.join(FIXTURES, name)
+    return [(f.rule_id, f.line)
+            for f in analyze_paths([path], root=REPO)]
+
+
+# ------------------------------------------------------------ per-rule exact
+
+def test_r1_host_sync_positive():
+    assert all_hits("r1_pos.py") == [
+        ("R1", 8), ("R1", 13), ("R1", 18), ("R1", 23)]
+
+
+def test_r1_host_sync_negative():
+    assert hits("r1_neg.py", "R1") == []
+
+
+def test_r2_traced_branch_positive():
+    assert all_hits("r2_pos.py") == [
+        ("R2", 7), ("R2", 14), ("R2", 21), ("R2", 28)]
+
+
+def test_r2_traced_branch_negative():
+    assert hits("r2_neg.py", "R2") == []
+
+
+def test_r3_key_reuse_positive():
+    assert all_hits("r3_pos.py") == [("R3", 7), ("R3", 13), ("R3", 19)]
+
+
+def test_r3_key_reuse_negative():
+    assert hits("r3_neg.py", "R3") == []
+
+
+def test_r4_unblocked_timing_positive():
+    assert all_hits("r4_pos.py") == [("R4", 11), ("R4", 19)]
+
+
+def test_r4_unblocked_timing_negative():
+    assert hits("r4_neg.py", "R4") == []
+
+
+def test_r5_missing_donate_positive():
+    assert all_hits("r5_pos.py") == [
+        ("R5", 11), ("R5", 17), ("R5", 20), ("R5", 25)]
+
+
+def test_r5_missing_donate_negative():
+    assert hits("r5_neg.py", "R5") == []
+
+
+def test_r6_unknown_axis_positive():
+    assert all_hits("r6_pos.py") == [("R6", 4), ("R6", 5), ("R6", 12)]
+
+
+def test_r6_unknown_axis_negative():
+    assert hits("r6_neg.py", "R6") == []
+
+
+def test_findings_carry_exact_location_and_hint():
+    path = os.path.join(FIXTURES, "r1_pos.py")
+    f = analyze_paths([path], root=REPO)[0]
+    assert f.path.endswith("tests/fixtures/jaxlint/r1_pos.py")
+    assert f.location == f"{f.path}:8"
+    assert f.hint  # every finding ships a rewrite suggestion
+
+
+def test_rule_registry_complete():
+    assert list(all_rules()) == ["R1", "R2", "R3", "R4", "R5", "R6"]
+
+
+# -------------------------------------------------------------- suppressions
+
+def test_inline_suppression_honored():
+    got = all_hits("suppressed.py")
+    # lines 7 (same-line), 12-13 (comment-line), 23 (disable=all) silenced;
+    # line 18 carries a WRONG rule id and must still fire
+    assert got == [("R1", 18)]
+
+
+# ------------------------------------------------------------------- ratchet
+
+def test_baseline_ratchet_flags_only_new(tmp_path):
+    import shutil
+
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "r3_pos.py"), tree / "old.py")
+    found = analyze_paths([str(tree)], root=str(tmp_path))
+    base = tmp_path / "base.json"
+    baseline.write(found, str(base))
+
+    # unchanged tree: nothing new
+    new, fixed = baseline.compare(
+        analyze_paths([str(tree)], root=str(tmp_path)),
+        baseline.load(str(base)))
+    assert new == [] and fixed == 0
+
+    # seed a fresh hazard: exactly it is new
+    (tree / "fresh.py").write_text(
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x.sum())\n")
+    new, fixed = baseline.compare(
+        analyze_paths([str(tree)], root=str(tmp_path)),
+        baseline.load(str(base)))
+    assert [(f.rule_id, f.path, f.line) for f in new] == \
+        [("R1", "tree/fresh.py", 5)]
+
+    # fix an old one: allowed (ratchet only tightens), reported as fixed
+    (tree / "old.py").write_text("x = 1\n")
+    (tree / "fresh.py").unlink()
+    new, fixed = baseline.compare(
+        analyze_paths([str(tree)], root=str(tmp_path)),
+        baseline.load(str(base)))
+    assert new == [] and fixed == 3
+
+
+def test_baseline_survives_line_shift(tmp_path):
+    src = ("import jax\n\n\n"
+           "def double(key):\n"
+           "    a = jax.random.normal(key, (2,))\n"
+           "    b = jax.random.normal(key, (2,))\n"
+           "    return a + b\n")
+    f = tmp_path / "mod.py"
+    f.write_text(src)
+    base = tmp_path / "b.json"
+    baseline.write(analyze_paths([str(f)], root=str(tmp_path)), str(base))
+    # prepend lines: same violation, shifted — count ratchet stays quiet
+    f.write_text("# a new comment\n# another\n" + src)
+    new, _ = baseline.compare(analyze_paths([str(f)], root=str(tmp_path)),
+                              baseline.load(str(base)))
+    assert new == []
+
+
+def test_cli_exit_codes(tmp_path):
+    """End-to-end through the real CLI: clean vs seeded-hazard trees."""
+    tree = tmp_path / "t"
+    tree.mkdir()
+    (tree / "ok.py").write_text("x = 1\n")
+    env = {**os.environ, "PYTHONPATH": REPO}
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "lint_tpu.py"),
+             "--json", "--no-baseline", *extra, str(tree)],
+            capture_output=True, text=True, env=env, cwd=str(tmp_path))
+
+    assert run().returncode == 0
+    (tree / "bad.py").write_text(
+        "import time, jax\n"
+        "def go(step, s, b):\n"
+        "    t0 = time.time()\n"
+        "    s, _ = step(s, b)\n"
+        "    return time.time() - t0\n")
+    out = run()
+    assert out.returncode == 1
+    report = json.loads(out.stdout)
+    assert [(f["rule"], f["line"]) for f in report["new_findings"]] == \
+        [("R4", 5)]
+
+
+def test_repo_surface_has_no_new_violations():
+    """THE ratchet: the committed baseline covers the current tree."""
+    base_path = os.path.join(REPO, "results", "jaxlint_baseline.json")
+    assert os.path.exists(base_path), (
+        "baseline missing — regenerate with `python lint_tpu.py "
+        "--write-baseline`")
+    findings = analyze_paths(default_paths(REPO), root=REPO)
+    new, _fixed = baseline.compare(findings, baseline.load(base_path))
+    assert new == [], (
+        "NEW jaxlint violations (fix them or, if truly intended, add an "
+        "inline `# jaxlint: disable=<id>` with a reason):\n" + "\n".join(
+            f"  {f.path}:{f.line}: {f.rule_id} {f.message}" for f in new))
+
+
+def test_repo_baseline_records_real_pre_existing_violations():
+    """The rules bite on real code, not just fixtures: the committed
+    baseline carries the tree's actual pre-existing debt (unsuppressed)."""
+    base_path = os.path.join(REPO, "results", "jaxlint_baseline.json")
+    entries = baseline.load(base_path)
+    assert len(entries) >= 1
+    assert all(e["file"] and e["line"] > 0 and e["rule"] for e in entries)

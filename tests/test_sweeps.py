@@ -1,0 +1,44 @@
+"""Sweep-row selection (pdnlp_tpu.utils.sweeps): the exact-name rule that
+stops substring-superset grid collisions from silently re-running chip-time
+rows (ADVICE round-5 item 1 — now shared by every sweep script)."""
+from pdnlp_tpu.utils.sweeps import make_selected, parse_only
+
+GRID = {
+    "b64_lr6e-05_ema0.99_3ep": 1,
+    "tanh_b64_lr6e-05_ema0.99_3ep": 2,
+    "tanh_b64_lr8e-05_ema0.99_1ep": 3,
+}
+
+
+def test_no_tokens_selects_everything():
+    s = make_selected([], GRID)
+    assert all(s(n) for n in GRID)
+
+
+def test_exact_name_beats_substring_superset():
+    # the real collision: the erf row is a SUBSTRING of its tanh sibling
+    s = make_selected(["b64_lr6e-05_ema0.99_3ep"], GRID)
+    assert s("b64_lr6e-05_ema0.99_3ep")
+    assert not s("tanh_b64_lr6e-05_ema0.99_3ep")
+
+
+def test_non_row_token_substring_matches():
+    s = make_selected(["tanh"], GRID)
+    assert not s("b64_lr6e-05_ema0.99_3ep")
+    assert s("tanh_b64_lr6e-05_ema0.99_3ep")
+    assert s("tanh_b64_lr8e-05_ema0.99_1ep")
+
+
+def test_comma_and_space_tokens():
+    assert parse_only(["a,b", "c", ""]) == ["a", "b", "c"]
+    s = make_selected(parse_only(["tanh_b64_lr8e-05_ema0.99_1ep,3ep"]), GRID)
+    assert s("tanh_b64_lr8e-05_ema0.99_1ep")      # exact
+    assert s("b64_lr6e-05_ema0.99_3ep")           # substring token "3ep"
+    assert s("tanh_b64_lr6e-05_ema0.99_3ep")
+
+
+def test_mixed_exact_and_substring():
+    s = make_selected(["b64_lr6e-05_ema0.99_3ep", "8e-05"], GRID)
+    assert s("b64_lr6e-05_ema0.99_3ep")
+    assert s("tanh_b64_lr8e-05_ema0.99_1ep")
+    assert not s("tanh_b64_lr6e-05_ema0.99_3ep")

@@ -246,3 +246,53 @@ def test_eval_batches_uploaded_once(cfg, args):
     other = _ListLoader([_batch(cfg, seed=11)])
     tr.dev(other)                # a different loader replaces the cache
     assert len(puts) == 3
+
+
+class _ShufflingLoader:
+    """Yields a DIFFERENT batch on every iteration — the loader shape the
+    identity-keyed eval cache must not silently freeze."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.iteration = 0
+
+    def __len__(self):
+        return 1
+
+    def set_epoch(self, e):
+        pass
+
+    def __iter__(self):
+        self.iteration += 1
+        yield _batch(self.cfg, seed=100 + self.iteration)
+
+
+def test_static_eval_false_reevaluates_fresh_batches(cfg, args):
+    """``static_eval=False`` opts a shuffling/augmenting loader out of the
+    identity-keyed device cache: every call re-uploads and re-evaluates the
+    CURRENT iteration's batches (ADVICE round-5 item 3)."""
+    state, tx = _state_and_tx(cfg, args)
+    puts = []
+    tr = Trainer(args, cfg, state,
+                 make_train_step(cfg, tx, args), make_eval_step(cfg, args),
+                 put=lambda b: puts.append(1) or b)
+    loader = _ShufflingLoader(cfg)
+
+    # default (static_eval=True): first iteration's batches are frozen
+    first = tr.dev(loader)
+    assert loader.iteration == 1 and len(puts) == 1
+    assert tr.dev(loader) == first
+    assert loader.iteration == 1 and len(puts) == 1  # cache hit: no re-pull
+
+    # static_eval=False: the loader is re-iterated and re-uploaded
+    r2 = tr.dev(loader, static_eval=False)
+    assert loader.iteration == 2 and len(puts) == 2
+    r3 = tr.dev(loader, static_eval=False)
+    assert loader.iteration == 3 and len(puts) == 3
+    assert r2 != r3              # different batches -> different metrics
+    # the static cache was left untouched: a static dev() still hits it
+    assert tr.dev(loader) == first and len(puts) == 3
+    # test() honors the flag too
+    res = tr.test(loader, static_eval=False)
+    assert loader.iteration == 4 and len(puts) == 4
+    assert set(res) >= {"loss", "accuracy", "y_true", "y_pred"}
