@@ -29,6 +29,15 @@ and ``mfu_pct`` assumes the default pure-DP mesh — under ``--mesh_shape``
 with tp/sp axes the per-chip FLOP share changes and the field is not
 comparable.
 
+Flag note: ``--pipeline <mode|all>`` is the input-pipeline COMPARISON smoke
+(``pipeline_smoke`` below, per-mode steps/s + transport counters), not a
+knob of the headline bench — it intercepts before ``Args`` parsing.  The
+headline bench always runs ``Args.pipeline="auto"`` (device-resident when
+eligible; that IS the shipped optimization) and reports the resolved mode
+plus measured transport in its JSON (``pipeline``/``transport``).  Other
+entrypoints (``single-tpu-cls.py``, ``multi-tpu-*-cls.py``) expose
+``--pipeline`` as the ordinary mode override.
+
 Methodology notes (vs the reference's timing):
 - the timed epoch starts AFTER the train step is compiled (AOT ``.lower()
   .compile()``), the analog of the reference's warm CUDA context; XLA's
@@ -185,8 +194,177 @@ def serve_smoke(argv) -> None:
                  f"(expected 0) — see {out_path}")
 
 
+def pipeline_smoke(argv, modes_arg: str) -> None:
+    """``--pipeline {resident,prefetch,sync,all}``: input-pipeline A/B.
+
+    Short seeded training runs (bert-tiny, mesh DP) through ONE shared
+    jitted step, one run per pipeline mode, reporting steps/s and the
+    transport counters (bytes uploaded per step, put-wait seconds,
+    padding-waste ratio) — the numbers behind the device-resident claim:
+    0 steady-state bytes/step at >= the sync pipeline's rate, with BITWISE
+    identical per-step losses (enforced; a mismatch exits non-zero, as
+    does any in-loop upload in resident mode).  ``resident`` is refused —
+    loudly, with the reason recorded in the JSON — when the loader has no
+    frozen ``EncodedDataset`` (a shuffling/augmenting collator re-encodes
+    per epoch; there is nothing deterministic to hold in HBM).  Writes
+    ``results/pipeline_smoke.json`` (override: ``--pipeline_out``); steps
+    per mode: ``--pipeline_steps`` (default 30).  Deterministic and
+    CPU-safe: a seeded synthetic corpus stands in when the real one is
+    absent.
+    """
+    import random
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pdnlp_tpu.data import Collator, DataLoader, WordPieceTokenizer, build_vocab
+    from pdnlp_tpu.data.collate import EncodedDataset
+    from pdnlp_tpu.data.pipeline import build_pipeline
+    from pdnlp_tpu.data.sampler import DistributedShardSampler
+    from pdnlp_tpu.parallel import (
+        make_global_batch, make_mesh, make_parallel_train_step,
+        setup_sharded_model,
+    )
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, out_path = pop_cli_flag(
+        argv, "--pipeline_out", os.path.join("results", "pipeline_smoke.json"))
+    # default covers one full epoch incl. the short final chunk, so the
+    # padding-waste counter is exercised, not just defined
+    argv, n_steps = pop_cli_flag(argv, "--pipeline_steps", 32, int)
+    args = parse_cli(argv, base=Args(
+        model="bert-tiny", max_seq_len=32, train_batch_size=32,
+        learning_rate=1e-3, log_every=10 ** 9))
+    all_modes = ("sync", "prefetch", "resident")
+    modes = all_modes if modes_arg == "all" else tuple(modes_arg.split(","))
+    for m in modes:
+        if m not in all_modes:
+            sys.exit(f"--pipeline {m!r}: pick from "
+                     f"{'|'.join(all_modes)}|all")
+
+    if os.path.exists(args.data_path):
+        from pdnlp_tpu.data import load_data
+        from pdnlp_tpu.data.tokenizer import get_or_build_vocab
+
+        corpus = load_data(args.data_path)[:1024]
+        tok = WordPieceTokenizer(get_or_build_vocab(args))
+    else:
+        chars = "天地人你我他好坏大小上下来去爱恨喜怒哀乐高兴悲伤讨厌愤怒"
+        rng = random.Random(args.seed)
+        corpus = [("".join(rng.choice(chars)
+                           for _ in range(rng.randint(6, args.max_seq_len))),
+                   rng.randrange(args.num_labels)) for _ in range(1010)]
+        tok = WordPieceTokenizer(build_vocab((t for t, _ in corpus),
+                                             size=256))
+
+    def fresh_loader(encoded: bool = True):
+        return DataLoader(
+            corpus, Collator(tok, args.max_seq_len), args.train_batch_size,
+            sampler=DistributedShardSampler(len(corpus), shuffle=True,
+                                            seed=args.seed),
+            prefetch=args.prefetch,
+            encoded=EncodedDataset(corpus, tok, args.max_seq_len)
+            if encoded else None)
+
+    mesh = make_mesh(num_devices=args.num_devices, shape=args.mesh_shape)
+    cfg, tx, state0, sh = setup_sharded_model(args, tok.vocab_size, mesh,
+                                              "dp")
+    step = make_parallel_train_step(cfg, tx, args, mesh, sh)
+    put = make_global_batch(mesh)
+
+    rows, losses = [], {}
+    for mode in modes:
+        loader = fresh_loader()
+        pipe = build_pipeline(args.replace(pipeline=mode), loader, put=put,
+                              mesh=mesh)
+        # compile step + (resident) gather outside the timed window
+        warm = pipe.warmup_batch(1)
+        wstate, m = step(jax.tree_util.tree_map(jnp.copy, state0), warm)
+        float(jax.device_get(m["loss"]))
+        del wstate
+        pipe.stats.__init__()  # drop warmup counts; keep steady-state only
+        pipe.stats.mode = mode
+
+        state = jax.tree_util.tree_map(jnp.copy, state0)
+        seen, epoch = [], 0
+        t0 = time.monotonic()
+        while len(seen) < n_steps:
+            pipe.set_epoch(epoch)
+            for batch, n, _fused, _ex in pipe.macro_batches(1):
+                state, m = step(state, batch)
+                seen.append(m["loss"])
+                if len(seen) == n_steps:
+                    break
+            epoch += 1
+        losses[mode] = [float(x) for x in jax.device_get(seen)]
+        elapsed = time.monotonic() - t0
+        del state
+        snap = pipe.stats.snapshot()
+        rows.append({"mode": mode, "steps": n_steps,
+                     "steps_per_sec": round(n_steps / elapsed, 2),
+                     **{k: snap[k] for k in (
+                         "bytes_per_step", "bytes_uploaded_in_loop",
+                         "bytes_uploaded_total", "puts_in_loop",
+                         "put_wait_sec", "padding_waste_ratio",
+                         "prefetch_in_flight_max")}})
+
+    # the refusal gate, demonstrated: no EncodedDataset -> no resident mode
+    try:
+        build_pipeline(args.replace(pipeline="resident"),
+                       fresh_loader(encoded=False), put=put, mesh=mesh)
+        refusal = None
+    except ValueError as e:
+        refusal = str(e)
+
+    by_mode = {r["mode"]: r for r in rows}
+    parity = None
+    if "sync" in losses and "resident" in losses:
+        parity = losses["sync"] == losses["resident"]
+    result = {
+        "metric": "pipeline_smoke",
+        "model": args.model,
+        "batch_size": args.train_batch_size,
+        "seq_len": args.max_seq_len,
+        "devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+        "dtype": args.dtype,
+        "pipelines": rows,
+        "loss_parity_bitwise": parity,
+        "resident_vs_sync_speedup": round(
+            by_mode["resident"]["steps_per_sec"]
+            / by_mode["sync"]["steps_per_sec"], 3)
+        if {"resident", "sync"} <= set(by_mode) else None,
+        "resident_refusal_without_encoded": refusal,
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, out_path)
+    print(json.dumps(result))
+    if "resident" in by_mode and \
+            by_mode["resident"]["bytes_uploaded_in_loop"] != 0:
+        sys.exit("pipeline smoke FAILED: resident mode uploaded "
+                 f"{by_mode['resident']['bytes_uploaded_in_loop']} in-loop "
+                 f"bytes (expected 0) — see {out_path}")
+    if parity is False:
+        sys.exit("pipeline smoke FAILED: resident losses diverge from sync "
+                 f"— the gather is not bitwise faithful; see {out_path}")
+    if refusal is None:
+        sys.exit("pipeline smoke FAILED: resident mode accepted a loader "
+                 "with no EncodedDataset (non-deterministic collation)")
+
+
 def main() -> None:
     argv = sys.argv[1:]
+    if "--pipeline" in argv:
+        from pdnlp_tpu.utils.config import pop_cli_flag
+
+        argv, modes_arg = pop_cli_flag(argv, "--pipeline", "all")
+        return pipeline_smoke(argv, modes_arg)
     if "--serve" in argv:
         # No pretrain-cache key to fold a leaked PDNLP_GELU_TANH into here:
         # serving would silently run tanh forwards over an erf-trained
@@ -427,6 +605,11 @@ def main() -> None:
         "platform": jax.devices()[0].platform,
         "dtype": args.dtype,
         "fuse_steps": args.fuse_steps,
+        # input-pipeline mode + measured transport (utils.metrics
+        # .TransportStats): resident mode must show 0 in-loop bytes/step
+        "pipeline": trainer.pipeline.mode if trainer.pipeline else None,
+        "transport": trainer.pipeline.stats.snapshot()
+        if trainer.pipeline else None,
         "init_from": args.init_from,
         "note": ("fine-tuned from in-repo two-phase pretrain (MLM over the "
                  "40k-text corpus + supervised stage over the ~30k labeled "

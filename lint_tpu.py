@@ -3,7 +3,7 @@
 
 Pure-AST: runs instantly, never imports jax (safe on images where the TPU
 plugin makes ``import jax`` slow or fatal).  See ``pdnlp_tpu/analysis/``
-for the rules (R1-R6) and README.md for the rule table + suppression
+for the rules (R1-R7) and README.md for the rule table + suppression
 syntax.
 
 Usage:

@@ -16,6 +16,10 @@ R4   unblocked-async-timing         timer deltas around dispatched work with
 R5   train-step-missing-donate      train-step-shaped jit without
                                     ``donate_argnums`` (transient 2x HBM)
 R6   unknown-partition-axis         ``PartitionSpec`` axis no mesh declares
+R7   device-put-in-step-loop        per-step host->device upload inside a
+                                    loop that dispatches a jitted step (the
+                                    transport tax ``data.pipeline``'s
+                                    resident/prefetch modes eliminate)
 ===  =============================  ==========================================
 
 CLI: ``python lint_tpu.py`` (or ``python -m pdnlp_tpu.analysis``); library:

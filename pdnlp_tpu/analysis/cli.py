@@ -85,7 +85,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="lint_tpu.py",
         description="jaxlint: AST-based JAX/TPU tracing-hazard analyzer "
-                    "(rules R1-R6, baseline-ratcheted)")
+                    "(rules R1-R7, baseline-ratcheted)")
     p.add_argument("paths", nargs="*",
                    help="files/dirs to scan (default: the repo's standard "
                         "hazard surface)")

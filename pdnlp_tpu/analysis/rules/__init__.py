@@ -10,4 +10,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r4_timing,
     r5_donate,
     r6_mesh_axes,
+    r7_put_in_loop,
 )

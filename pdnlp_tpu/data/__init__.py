@@ -3,3 +3,7 @@ from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
 from pdnlp_tpu.data.collate import Collator, EncodedDataset
 from pdnlp_tpu.data.sampler import DistributedShardSampler
 from pdnlp_tpu.data.loader import DataLoader
+from pdnlp_tpu.data.pipeline import (
+    DevicePrefetchPipeline, DeviceResidentPipeline, InputPipeline,
+    SyncPipeline, build_pipeline,
+)

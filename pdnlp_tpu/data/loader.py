@@ -72,23 +72,27 @@ class DataLoader:
         _SENTINEL = object()
         stop = threading.Event()
 
+        def put_or_stop(item) -> bool:
+            """Bounded put that notices consumer abandonment: EVERY worker
+            put (batches, the sentinel, a raised exception) polls the stop
+            flag, so an early ``break`` in the consumer can never strand the
+            thread blocked on a full queue."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def worker():
             try:
                 for chunk in self._chunks():
-                    batch = self._make(chunk)
-                    # Bounded put that notices consumer abandonment, so an
-                    # early `break` in the consumer can't strand us forever.
-                    while not stop.is_set():
-                        try:
-                            q.put(batch, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
+                    if not put_or_stop(self._make(chunk)):
                         return
-                q.put(_SENTINEL)
+                put_or_stop(_SENTINEL)
             except BaseException as e:  # propagate to the consumer, not /dev/null
-                q.put(e)
+                put_or_stop(e)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
@@ -101,10 +105,7 @@ class DataLoader:
                     raise item
                 yield item
         finally:
+            # every worker put is stop-aware (0.1 s poll), so abandonment
+            # tears down in ONE bounded join — no drain busy-spin
             stop.set()
-            while t.is_alive():  # drain so any blocked put wakes up
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    pass
-                t.join(timeout=0.05)
+            t.join(timeout=2.0)

@@ -28,7 +28,7 @@ from pdnlp_tpu.parallel import (
     setup_sharded_model,
 )
 from pdnlp_tpu.parallel.execution import make_parallel_multi_step
-from pdnlp_tpu.train.setup import setup_data
+from pdnlp_tpu.train.setup import setup_data, setup_pipeline
 from pdnlp_tpu.train.trainer import Trainer
 from pdnlp_tpu.utils.config import Args
 from pdnlp_tpu.utils.logging import rank0_print
@@ -87,15 +87,18 @@ def build_parallel_trainer(
     if args.fuse_steps > 1 and not explicit_collectives:
         multi_step = make_parallel_multi_step(cfg, tx, args, mesh, shardings)
         put_fused = make_global_batch(mesh, leading_stack=True)
+    put = make_global_batch(mesh)
+    pipeline = setup_pipeline(args, train_loader, put=put,
+                              put_fused=put_fused, mesh=mesh)
     trainer = Trainer(args, cfg, state, train_step, eval_step,
-                      put=make_global_batch(mesh),
-                      multi_step=multi_step, put_fused=put_fused)
+                      put=put, multi_step=multi_step, put_fused=put_fused,
+                      pipeline=pipeline)
     rank0_print(
         f"mesh: {dict(mesh.shape)}  process {jax.process_index()}/{jax.process_count()}"
         f"  mode: {mode}{' +shard_map' if explicit_collectives else ''}"
         f"  dtype: {args.dtype}  global batch: "
         f"{args.train_batch_size * mesh.shape.get('data', 1) if scale_batch else args.train_batch_size}"
-        f"  steps/epoch: {len(train_loader)}")
+        f"  steps/epoch: {len(train_loader)}  pipeline: {pipeline.mode}")
     return trainer, train_loader, dev_loader
 
 
@@ -148,8 +151,13 @@ def build_sp_trainer(args: Args, mesh=None):
     example = next(iter(train_loader))
     train_step = make_sp_train_step(cfg, tx, args, mesh)(example)
     eval_step = make_sp_eval_step(cfg, args, mesh)(example)
+    sp_put = make_sp_batch(mesh)
+    # resident disallowed: the ring slices each batch along seq, not the
+    # plain data-axis placement the resident gather produces
+    pipeline = setup_pipeline(args, train_loader, put=sp_put,
+                              allow_resident=False)
     trainer = Trainer(args, cfg, state, train_step, eval_step,
-                      put=make_sp_batch(mesh))
+                      put=sp_put, pipeline=pipeline)
     rank0_print(f"mesh: {dict(mesh.shape)}  process "
                 f"{jax.process_index()}/{jax.process_count()}  ring axis: "
                 f"{SEQ} (local seq {args.max_seq_len // mesh.shape[SEQ]})  "
@@ -199,8 +207,13 @@ def build_pipeline_trainer(args: Args, mesh=None):
     train_step = make_pp_train_step(cfg, tx, args, mesh,
                                     n_micro=args.microbatches)
     eval_step = make_pp_eval_step(cfg, args, mesh, n_micro=args.microbatches)
+    pp_put = make_pp_batch(mesh)
+    # resident disallowed: pp places batches along the stage-major layout,
+    # not the plain data-axis sharding the resident gather produces
+    pipeline = setup_pipeline(args, train_loader, put=pp_put,
+                              allow_resident=False)
     trainer = Trainer(args, cfg, state, train_step, eval_step,
-                      put=make_pp_batch(mesh))
+                      put=pp_put, pipeline=pipeline)
     rank0_print(f"mesh: {dict(mesh.shape)}  process "
                 f"{jax.process_index()}/{jax.process_count()}  stages: "
                 f"{mesh.shape[STAGE]} x {cfg.num_layers // mesh.shape[STAGE]}"

@@ -63,6 +63,18 @@ def setup_data(args, *, num_shards: int = 1, shard_id: int = 0,
     return train_loader, dev_loader, tok
 
 
+def setup_pipeline(args, loader, put=None, put_fused=None, mesh=None,
+                   allow_resident: bool = True):
+    """The input pipeline for a wired loader (``data.pipeline``): resident
+    (split held in HBM, zero steady-state transport) / double-buffered
+    prefetch / sync behind ``--pipeline``; shared by the strategy runners
+    and the single-device entrypoint so the mode decision can't drift."""
+    from pdnlp_tpu.data.pipeline import build_pipeline
+
+    return build_pipeline(args, loader, put=put, put_fused=put_fused,
+                          mesh=mesh, allow_resident=allow_resident)
+
+
 def setup_model(args, vocab_size: int, total_steps: int = None):
     """(cfg, tx, state) — seeded the reference's way (one seed, 123).
     ``total_steps`` sizes the optional ``--lr_schedule``."""

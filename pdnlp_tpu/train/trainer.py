@@ -72,6 +72,7 @@ class Trainer:
         put: Optional[Callable] = None,
         multi_step: Optional[Callable] = None,
         put_fused: Optional[Callable] = None,
+        pipeline=None,
     ):
         self.args = args
         self.cfg = cfg
@@ -83,6 +84,14 @@ class Trainer:
         # optimizer steps; the loader's remainder runs through train_step
         self.multi_step = multi_step
         self.put_fused = put_fused or self.put
+        # input pipeline (data.pipeline): when it wraps the loader train()
+        # is given, batches arrive ALREADY on device (resident mode:
+        # zero steady-state transport; prefetch: double-buffered upload)
+        # and the per-step self.put disappears from the hot loop.  Keyed
+        # by loader identity so a trainer handed a different loader falls
+        # back to the classic put-in-loop path instead of training on the
+        # wrong data.
+        self.pipeline = pipeline
         self.best_accuracy = 0.0
         self._best_params = None  # device-held copy; written once at end
         # (minutes-since-train-start, dev accuracy) per in-loop eval: the
@@ -101,6 +110,18 @@ class Trainer:
         carries one (``--ema_decay``), else the live params."""
         return self.state.get("ema", self.state["params"])
 
+    def _use_pipeline(self, loader) -> bool:
+        """The pipeline speaks for ``loader`` only when it wraps that exact
+        object (identity-keyed, like the eval cache)."""
+        return self.pipeline is not None and self.pipeline.loader is loader
+
+    def _first_device_batch(self, train_loader):
+        """One device batch shaped/placed exactly like the hot loop's."""
+        if self._use_pipeline(train_loader):
+            return self.pipeline.warmup_batch(1)
+        host = next(iter(train_loader), None)
+        return self.put(host) if host is not None else None
+
     # -------------------------------------------------- warmup / probe
     def warmup_compile(self, train_loader, dev_loader=None) -> None:
         """AOT-compile the step programs before the timed epoch (the
@@ -109,16 +130,28 @@ class Trainer:
         compile on their first real call instead — cheap under a warmed
         persistent ``xla_cache``.  ``dev_loader`` supplies the eval step's
         real batch shape (dev_batch_size may differ from train's)."""
-        host = next(iter(train_loader), None)
-        if host is None:
+        use_pipe = self._use_pipeline(train_loader)
+        if use_pipe:
+            host = None
+            batch = self.pipeline.warmup_batch(1)
+        else:
+            host = next(iter(train_loader), None)
+            batch = self.put(host) if host is not None else None
+        if batch is None:
             return
-        batch = self.put(host)
         if hasattr(self.train_step, "lower"):
             self.train_step.lower(self.state, batch).compile()
         if self.multi_step is not None and hasattr(self.multi_step, "lower"):
             k = getattr(self.args, "fuse_steps", 1)
-            stacked = {key: np.stack([v] * k) for key, v in host.items()}
-            self.multi_step.lower(self.state, self.put_fused(stacked)).compile()
+            if use_pipe:
+                fused = self.pipeline.warmup_batch(k)
+                # a short epoch may have no full K-group to warm against
+                if fused is not None and fused["input_ids"].ndim == 3:
+                    self.multi_step.lower(self.state, fused).compile()
+            else:
+                stacked = {key: np.stack([v] * k) for key, v in host.items()}
+                self.multi_step.lower(self.state,
+                                      self.put_fused(stacked)).compile()
         if self.eval_step is not None and hasattr(self.eval_step, "lower"):
             dev_host = (next(iter(dev_loader), None)
                         if dev_loader is not None else None)
@@ -137,12 +170,11 @@ class Trainer:
         with ``probe n/a`` rather than die inside the probe."""
         if getattr(self.args, "offload_opt_state", False):
             return None
-        host = next(iter(train_loader), None)
-        if host is None:
+        batch = self._first_device_batch(train_loader)
+        if batch is None:
             return None
         import jax.numpy as jnp
 
-        batch = self.put(host)
         state = m = None
         try:
             state = jax.tree_util.tree_map(jnp.copy, self.state)
@@ -163,22 +195,20 @@ class Trainer:
             del state, m  # release the doubled state promptly
         return n / dt if dt > 0 else None
 
-    def _macro_batches(self, loader, k: int):
-        """Yield (batch, n_steps, fused): groups of ``k`` host batches
-        stacked on a leading step axis, remainder as single steps."""
-        if k <= 1 or self.multi_step is None:
-            for b in loader:
-                yield b, 1, False
-            return
-        buf = []
-        for b in loader:
-            buf.append(b)
-            if len(buf) == k:
-                yield ({key: np.stack([x[key] for x in buf]) for key in buf[0]},
-                       k, True)
-                buf = []
-        for b in buf:
-            yield b, 1, False
+    def _macro_batches(self, loader, k: int, stage=None):
+        """Yield ``(host_batch, n_steps, fused, examples)``: groups of ``k``
+        host batches stacked on a leading step axis, remainder as singles.
+
+        Fused groups are assembled into ``stage``'s preallocated ping-pong
+        buffers (``data.pipeline._MacroStage``) instead of a fresh
+        ``np.stack`` per key per group; the train loop verifies on the
+        first fused upload that the uploaded batch does not alias the
+        staging memory (identity/zero-copy puts disable reuse) — a yielded
+        fused batch is only valid until the next iteration."""
+        from pdnlp_tpu.data.pipeline import host_macro_batches
+
+        eff_k = k if self.multi_step is not None else 1
+        yield from host_macro_batches(loader, eff_k, stage)
 
     # ------------------------------------------------------------------ train
     def train(self, train_loader, dev_loader=None,
@@ -231,11 +261,36 @@ class Trainer:
             rate = self.probe_steps_per_sec(train_loader, args.probe_steps)
             if rate is not None:
                 rank0_print(f"probe steps/s：{rate:.2f}")
+        # the per-step upload route: a pipeline wrapping THIS loader hands
+        # over device batches (resident: zero steady-state transport;
+        # prefetch: double-buffered upload); otherwise put runs inline (the
+        # sync fallback the jaxlint R7 baseline records)
+        use_pipe = self._use_pipeline(train_loader)
+        stage = None
+        if not use_pipe:
+            from pdnlp_tpu.data.pipeline import _MacroStage
+
+            stage = _MacroStage(fuse)
         start = time.time()
         self._t0 = start
         for epoch in range(1, args.epochs + 1):
-            train_loader.set_epoch(epoch - 1)
-            for batch, n, fused in self._macro_batches(train_loader, fuse):
+            if gstep + len(train_loader) <= start_step:
+                # resume fast-forward, whole-epoch short-circuit: nothing in
+                # this epoch executes, so don't collate (or, in prefetch
+                # mode, upload) any of its batches — the seeded sampler
+                # makes skipping by count exact
+                gstep += len(train_loader)
+                if heartbeat is not None:
+                    heartbeat.beat()
+                continue
+            if use_pipe:
+                self.pipeline.set_epoch(epoch - 1)
+                groups = self.pipeline.macro_batches(
+                    fuse if self.multi_step is not None else 1)
+            else:
+                train_loader.set_epoch(epoch - 1)
+                groups = self._macro_batches(train_loader, fuse, stage)
+            for batch, n, fused, n_examples in groups:
                 if gstep + n <= start_step:  # already done before the restart
                     gstep += n
                     if heartbeat is not None:  # long fast-forwards stay live
@@ -254,15 +309,18 @@ class Trainer:
                         and jax.process_index() == fault_proc:
                     os._exit(13)
                 if fused:
-                    self.state, metrics = self.multi_step(
-                        self.state, self.put_fused(batch))
+                    dev = batch if use_pipe else self.put_fused(batch)
+                    if stage is not None:
+                        stage.verify(batch, dev)  # aliasing guard, once
+                    self.state, metrics = self.multi_step(self.state, dev)
                     last_loss = metrics["loss"][-1]
                 else:
-                    self.state, metrics = self.train_step(self.state, self.put(batch))
+                    self.state, metrics = self.train_step(
+                        self.state, batch if use_pipe else self.put(batch))
                     last_loss = metrics["loss"]
                 prev = gstep
                 gstep += n
-                examples += int(batch["example_weight"].sum())
+                examples += n_examples
                 profiler.step(gstep)
                 if heartbeat is not None:
                     heartbeat.beat()

@@ -130,7 +130,20 @@ class Args:
                                                   # file (JSON/YAML, the
                                                   # default_config.yaml
                                                   # analog — accel.py)
-    prefetch: int = 2                             # host->device pipeline depth
+    prefetch: int = 2                             # loader collation lookahead
+    pipeline: str = "auto"                        # input pipeline (data/
+                                                  # pipeline.py): auto|
+                                                  # resident (split held in
+                                                  # HBM, zero per-step
+                                                  # transport)|prefetch
+                                                  # (double-buffered upload)
+                                                  # |sync (reference-style
+                                                  # put-in-loop).  auto =
+                                                  # resident when eligible,
+                                                  # else prefetch
+    pipeline_hbm_mb: int = 128                    # resident-mode budget: the
+                                                  # encoded split must fit
+                                                  # this many MB of HBM
     log_every: int = 1
     profile_dir: Optional[str] = None             # jax.profiler trace output
     warmup_compile: bool = False                  # AOT-compile steps before

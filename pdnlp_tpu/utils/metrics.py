@@ -11,7 +11,14 @@ Two halves:
   inference-serving subsystem (``pdnlp_tpu.serve``) aggregates into latency
   p50/p95/p99, queue depth, batch occupancy and compile-cache counters, all
   JSON-snapshot friendly so serve metrics land in ``results/`` next to the
-  training artifacts.
+  training artifacts;
+- ``TransportStats`` — host->device transport counters for the input
+  pipeline (``pdnlp_tpu.data.pipeline``): bytes uploaded (split into
+  steady-state in-loop uploads vs amortized one-time/epoch uploads),
+  put-wait seconds, padding-waste ratio, and the prefetch in-flight
+  high-water mark.  ``bench.py --pipeline`` snapshots these so the
+  zero-transport claim of the device-resident mode is measured, not
+  asserted.
 """
 from __future__ import annotations
 
@@ -104,6 +111,87 @@ class Histogram:
             "p95": self.percentile(95),
             "p99": self.percentile(99),
         }
+
+
+class TransportStats:
+    """Host->device transport telemetry for one input pipeline.
+
+    Distinguishes *in-loop* uploads (paid per step, inside the timed epoch —
+    the transport tax the device-resident pipeline eliminates) from
+    *amortized* uploads (the one-time dataset residency and the per-epoch
+    permutation indices).  Thread-safe: the prefetch pipeline records from
+    its upload worker while the train loop reads.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.mode: Optional[str] = None
+        self.bytes_total = 0        # every host->device upload
+        self.bytes_in_loop = 0      # uploads issued per step, in the loop
+        self.puts_in_loop = 0
+        self.puts_amortized = 0
+        self.put_wait_sec = 0.0     # host seconds blocked inside put()
+        self.steps = 0              # optimizer steps fed
+        self.rows = 0               # batch rows fed (incl. filler padding)
+        self.rows_real = 0          # weight-1 rows (real examples)
+        self.in_flight = 0          # uploaded but not yet handed to the loop
+        self.in_flight_max = 0
+
+    def record_upload(self, nbytes: int, wait_sec: float,
+                      in_loop: bool = True) -> None:
+        with self._lock:
+            self.bytes_total += int(nbytes)
+            self.put_wait_sec += float(wait_sec)
+            if in_loop:
+                self.bytes_in_loop += int(nbytes)
+                self.puts_in_loop += 1
+            else:
+                self.puts_amortized += 1
+
+    def record_batch(self, steps: int, rows: int, rows_real: int) -> None:
+        with self._lock:
+            self.steps += int(steps)
+            self.rows += int(rows)
+            self.rows_real += int(rows_real)
+
+    def put_started(self) -> None:
+        with self._lock:
+            self.in_flight += 1
+            self.in_flight_max = max(self.in_flight_max, self.in_flight)
+
+    def put_delivered(self) -> None:
+        with self._lock:
+            self.in_flight -= 1
+
+    @property
+    def bytes_per_step(self) -> float:
+        """Steady-state in-loop bytes per optimizer step — 0 for the
+        device-resident pipeline (the acceptance number)."""
+        return self.bytes_in_loop / self.steps if self.steps else 0.0
+
+    @property
+    def padding_waste(self) -> float:
+        """Fraction of fed rows that were zero-weight filler."""
+        return 1.0 - self.rows_real / self.rows if self.rows else 0.0
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-ready summary (the bench's ``transport`` block)."""
+        with self._lock:
+            return {
+                "mode": self.mode,
+                "steps": self.steps,
+                "puts_in_loop": self.puts_in_loop,
+                "puts_amortized": self.puts_amortized,
+                "bytes_uploaded_total": self.bytes_total,
+                "bytes_uploaded_in_loop": self.bytes_in_loop,
+                "bytes_per_step": round(self.bytes_in_loop / self.steps, 2)
+                if self.steps else 0.0,
+                "put_wait_sec": round(self.put_wait_sec, 6),
+                "padding_waste_ratio": round(
+                    1.0 - self.rows_real / self.rows, 6) if self.rows
+                else 0.0,
+                "prefetch_in_flight_max": self.in_flight_max,
+            }
 
 
 def per_class_stats(y_true: Sequence[int], y_pred: Sequence[int], num_classes: int):

@@ -21,15 +21,22 @@ from pdnlp_tpu.utils.metrics import classification_report
 
 
 def main(args: Args) -> float:
+    from pdnlp_tpu.train.setup import setup_pipeline
+
     train_loader, dev_loader, tok = setup_data(args)
     cfg, tx, state = setup_model(args, tok.vocab_size,
                                  total_steps=len(train_loader) * args.epochs)
+    # device-resident input (default): the encoded split lives on the chip,
+    # steady-state steps pay zero host->device transport (data/pipeline.py)
+    pipeline = setup_pipeline(args, train_loader)
     rank0_print(f"device: {jax.devices()[0].platform}  model: {args.model}  "
-                f"dtype: {args.dtype}  steps/epoch: {len(train_loader)}")
+                f"dtype: {args.dtype}  steps/epoch: {len(train_loader)}  "
+                f"pipeline: {pipeline.mode}")
     trainer = Trainer(
         args, cfg, state,
         make_train_step(cfg, tx, args), make_eval_step(cfg, args),
-        multi_step=make_multi_step(cfg, tx, args) if args.fuse_steps > 1 else None)
+        multi_step=make_multi_step(cfg, tx, args) if args.fuse_steps > 1 else None,
+        pipeline=pipeline)
     minutes = trainer.train(train_loader, dev_loader)
     # dev set doubles as the test set (single-gpu-cls.py:241-247)
     result = trainer.test(dev_loader)

@@ -1,0 +1,40 @@
+"""R7 negative: uploads hoisted out of the step loop, queue puts, and
+put-only / step-only loops."""
+import queue
+
+import jax
+
+
+def hoisted(train_step, state, loader, put):
+    batches = [put(b) for b in loader]   # comprehension staging: the fix
+    for batch in batches:
+        state, m = train_step(state, batch)
+    return state
+
+
+def resident(train_step, state, gather, perm, counter):
+    for _ in range(10):                  # on-device gather: no transport
+        batch, counter = gather(perm, counter)
+        state, m = train_step(state, batch)
+    return state
+
+
+def upload_only(put, loader):
+    out = []
+    for b in loader:                     # put with no step dispatch: a
+        out.append(put(b))               # staging loop, not the hazard
+    return out
+
+
+def queue_plumbing(train_step, state, loader, q: queue.Queue):
+    for b in loader:
+        q.put(b)                         # host queue, not device transport
+        state, m = train_step(state, b)
+    return state
+
+
+def upload_once(train_step, state, loader, sharding):
+    first = jax.device_put(next(iter(loader)), sharding)
+    for _ in range(30):                  # probe idiom: re-fed batch
+        state, m = train_step(state, first)
+    return state

@@ -1,0 +1,22 @@
+"""R7 positive: per-step uploads inside loops that dispatch jitted steps."""
+import jax
+
+
+def epoch(train_step, state, loader, put):
+    for batch in loader:
+        state, m = train_step(state, put(batch))       # line 7: put-in-loop
+    return state
+
+
+def epoch_explicit(train_step, state, loader, sharding):
+    for batch in loader:
+        dev = jax.device_put(batch, sharding)          # line 13: device_put
+        state, m = train_step(state, dev)
+    return state
+
+
+class Runner:
+    def run(self, loader):
+        while self.more():
+            b = self.put_fused(next(loader))           # line 21: method put
+            self.state, m = self.multi_step(self.state, b)

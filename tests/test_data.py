@@ -120,6 +120,31 @@ def test_loader_early_break_joins_worker(data, tok):
     assert threading.active_count() <= before
 
 
+def test_loader_mid_epoch_break_tears_down_bounded(data, tok):
+    """Regression: abandoning iteration mid-epoch must stop the worker in
+    ONE bounded join — including the case where the worker is parked on
+    the SENTINEL put (a full queue after the last batch), which the old
+    unbounded ``q.put(_SENTINEL)`` + drain busy-spin could strand."""
+    import threading
+    import time
+
+    col = Collator(tok, max_seq_len=16)
+    before = threading.active_count()
+    # two batches, prefetch=1: after the consumer takes batch 0, the worker
+    # lands blocked putting the sentinel behind the queued batch 1
+    loader = DataLoader(data[:64], col, batch_size=32, prefetch=1)
+    it = iter(loader)
+    next(it)
+    time.sleep(0.3)  # let the worker reach the blocked sentinel put
+    t0 = time.monotonic()
+    it.close()       # generator finally: stop + one bounded join
+    assert time.monotonic() - t0 < 2.5
+    deadline = time.monotonic() + 2.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert threading.active_count() <= before
+
+
 def test_collator_batch_shapes(tok):
     col = Collator(tok, max_seq_len=32)
     batch = col([("我很高兴", 5), ("讨厌", 3)], pad_to=4)

@@ -53,10 +53,10 @@ def test_trainer_fuses_with_remainder(corpus_path, tmp_path):
 
     orig = trainer._macro_batches
 
-    def counting(loader, k):
-        for batch, cnt, fused in orig(loader, k):
+    def counting(loader, k, stage=None):
+        for batch, cnt, fused, ex in orig(loader, k, stage):
             seen[0] += cnt
-            yield batch, cnt, fused
+            yield batch, cnt, fused, ex
 
     trainer._macro_batches = counting
     trainer.train(train_loader, dev_loader=None)

@@ -90,6 +90,21 @@ def test_r6_unknown_axis_negative():
     assert hits("r6_neg.py", "R6") == []
 
 
+def test_r7_put_in_step_loop_positive():
+    assert all_hits("r7_pos.py") == [("R7", 7), ("R7", 13), ("R7", 21)]
+
+
+def test_r7_put_in_step_loop_negative():
+    assert hits("r7_neg.py", "R7") == []
+
+
+def test_r7_hint_points_at_the_pipeline():
+    path = os.path.join(FIXTURES, "r7_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R7"][0]
+    assert "pdnlp_tpu.data.pipeline" in f.hint
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -99,7 +114,7 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    assert list(all_rules()) == ["R1", "R2", "R3", "R4", "R5", "R6"]
+    assert list(all_rules()) == ["R1", "R2", "R3", "R4", "R5", "R6", "R7"]
 
 
 # -------------------------------------------------------------- suppressions
