@@ -194,55 +194,23 @@ def serve_smoke(argv) -> None:
                  f"(expected 0) — see {out_path}")
 
 
-def pipeline_smoke(argv, modes_arg: str) -> None:
-    """``--pipeline {resident,prefetch,sync,all}``: input-pipeline A/B.
-
-    Short seeded training runs (bert-tiny, mesh DP) through ONE shared
-    jitted step, one run per pipeline mode, reporting steps/s and the
-    transport counters (bytes uploaded per step, put-wait seconds,
-    padding-waste ratio) — the numbers behind the device-resident claim:
-    0 steady-state bytes/step at >= the sync pipeline's rate, with BITWISE
-    identical per-step losses (enforced; a mismatch exits non-zero, as
-    does any in-loop upload in resident mode).  ``resident`` is refused —
-    loudly, with the reason recorded in the JSON — when the loader has no
-    frozen ``EncodedDataset`` (a shuffling/augmenting collator re-encodes
-    per epoch; there is nothing deterministic to hold in HBM).  Writes
-    ``results/pipeline_smoke.json`` (override: ``--pipeline_out``); steps
-    per mode: ``--pipeline_steps`` (default 30).  Deterministic and
-    CPU-safe: a seeded synthetic corpus stands in when the real one is
-    absent.
-    """
+def _smoke_train_setup(args):
+    """Shared scaffold for the ``--pipeline`` and ``--trace`` smokes: the
+    seeded corpus (real when present, synthetic otherwise), a
+    fresh-DataLoader factory, and ONE jitted DP train step on the bench
+    mesh — one copy, so the two smokes cannot drift in what they measure.
+    Returns ``(fresh_loader, mesh, state0, step, put)``."""
     import random
-    import time
 
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from pdnlp_tpu.data import Collator, DataLoader, WordPieceTokenizer, build_vocab
+    from pdnlp_tpu.data import (
+        Collator, DataLoader, WordPieceTokenizer, build_vocab,
+    )
     from pdnlp_tpu.data.collate import EncodedDataset
-    from pdnlp_tpu.data.pipeline import build_pipeline
     from pdnlp_tpu.data.sampler import DistributedShardSampler
     from pdnlp_tpu.parallel import (
         make_global_batch, make_mesh, make_parallel_train_step,
         setup_sharded_model,
     )
-    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
-
-    argv, out_path = pop_cli_flag(
-        argv, "--pipeline_out", os.path.join("results", "pipeline_smoke.json"))
-    # default covers one full epoch incl. the short final chunk, so the
-    # padding-waste counter is exercised, not just defined
-    argv, n_steps = pop_cli_flag(argv, "--pipeline_steps", 32, int)
-    args = parse_cli(argv, base=Args(
-        model="bert-tiny", max_seq_len=32, train_batch_size=32,
-        learning_rate=1e-3, log_every=10 ** 9))
-    all_modes = ("sync", "prefetch", "resident")
-    modes = all_modes if modes_arg == "all" else tuple(modes_arg.split(","))
-    for m in modes:
-        if m not in all_modes:
-            sys.exit(f"--pipeline {m!r}: pick from "
-                     f"{'|'.join(all_modes)}|all")
 
     if os.path.exists(args.data_path):
         from pdnlp_tpu.data import load_data
@@ -273,6 +241,52 @@ def pipeline_smoke(argv, modes_arg: str) -> None:
                                               "dp")
     step = make_parallel_train_step(cfg, tx, args, mesh, sh)
     put = make_global_batch(mesh)
+    return fresh_loader, mesh, state0, step, put
+
+
+def pipeline_smoke(argv, modes_arg: str) -> None:
+    """``--pipeline {resident,prefetch,sync,all}``: input-pipeline A/B.
+
+    Short seeded training runs (bert-tiny, mesh DP) through ONE shared
+    jitted step, one run per pipeline mode, reporting steps/s and the
+    transport counters (bytes uploaded per step, put-wait seconds,
+    padding-waste ratio) — the numbers behind the device-resident claim:
+    0 steady-state bytes/step at >= the sync pipeline's rate, with BITWISE
+    identical per-step losses (enforced; a mismatch exits non-zero, as
+    does any in-loop upload in resident mode).  ``resident`` is refused —
+    loudly, with the reason recorded in the JSON — when the loader has no
+    frozen ``EncodedDataset`` (a shuffling/augmenting collator re-encodes
+    per epoch; there is nothing deterministic to hold in HBM).  Writes
+    ``results/pipeline_smoke.json`` (override: ``--pipeline_out``); steps
+    per mode: ``--pipeline_steps`` (default 30).  Deterministic and
+    CPU-safe: a seeded synthetic corpus stands in when the real one is
+    absent.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pdnlp_tpu.data.pipeline import build_pipeline
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, out_path = pop_cli_flag(
+        argv, "--pipeline_out", os.path.join("results", "pipeline_smoke.json"))
+    # default covers one full epoch incl. the short final chunk, so the
+    # padding-waste counter is exercised, not just defined
+    argv, n_steps = pop_cli_flag(argv, "--pipeline_steps", 32, int)
+    args = parse_cli(argv, base=Args(
+        model="bert-tiny", max_seq_len=32, train_batch_size=32,
+        learning_rate=1e-3, log_every=10 ** 9))
+    all_modes = ("sync", "prefetch", "resident")
+    modes = all_modes if modes_arg == "all" else tuple(modes_arg.split(","))
+    for m in modes:
+        if m not in all_modes:
+            sys.exit(f"--pipeline {m!r}: pick from "
+                     f"{'|'.join(all_modes)}|all")
+
+    fresh_loader, mesh, state0, step, put = _smoke_train_setup(args)
 
     rows, losses = [], {}
     for mode in modes:
@@ -358,8 +372,159 @@ def pipeline_smoke(argv, modes_arg: str) -> None:
                  "with no EncodedDataset (non-deterministic collation)")
 
 
+def trace_smoke(argv) -> None:
+    """``--trace``: obs tracing smoke — overhead gate + phase breakdown.
+
+    Two short seeded training loops over ONE shared jitted step and
+    warmed pipeline: untraced (a disabled ``obs.Tracer``, the exact no-op
+    object production runs carry) vs traced (spans + per-step breakdown +
+    regression detector).  Both variants run ``--trace_repeats`` times
+    interleaved and keep their best rate — the honest comparison under CPU
+    scheduler noise.  Reports steps/s for both, the overhead percentage,
+    and the traced run's per-phase mean/p50/p95 breakdown embedded in the
+    JSON; writes ``results/trace_smoke.json`` (override ``--trace_out``)
+    plus the Chrome-trace export next to it, and EXITS NON-ZERO when the
+    overhead exceeds ``--trace_tolerance`` (default 2%) or the export
+    violates the Chrome-trace schema.  Deterministic and CPU-safe: the
+    seeded synthetic corpus stands in when the real one is absent.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pdnlp_tpu.data.pipeline import build_pipeline
+    from pdnlp_tpu.obs import RegressionDetector, StepBreakdown, Tracer
+    from pdnlp_tpu.obs.export import to_chrome_trace, write_chrome_trace
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, out_path = pop_cli_flag(
+        argv, "--trace_out", os.path.join("results", "trace_smoke.json"))
+    argv, n_steps = pop_cli_flag(argv, "--trace_steps", 48, int)
+    argv, repeats = pop_cli_flag(argv, "--trace_repeats", 3, int)
+    argv, tolerance = pop_cli_flag(argv, "--trace_tolerance", 2.0, float)
+    args = parse_cli(argv, base=Args(
+        model="bert-tiny", max_seq_len=32, train_batch_size=32,
+        learning_rate=1e-3, log_every=10 ** 9))
+
+    fresh_loader, mesh, state0, step, put = _smoke_train_setup(args)
+
+    # one pipeline per variant (the resident upload happens at build);
+    # the traced pipeline's tracer is swapped per repeat below
+    off = Tracer(enabled=False)
+    pipes = {"untraced": build_pipeline(args, fresh_loader(), put=put,
+                                        mesh=mesh, tracer=off),
+             "traced": build_pipeline(args, fresh_loader(), put=put,
+                                      mesh=mesh)}
+
+    # compile the step + gather outside every timed window
+    warm = pipes["untraced"].warmup_batch(1)
+    wstate, m = step(jax.tree_util.tree_map(jnp.copy, state0), warm)
+    float(jax.device_get(m["loss"]))
+    del wstate, warm
+
+    def timed_loop(pipe, tracer):
+        """The traced-trainer loop shape: data_wait around the iterator,
+        step_dispatch around the step, device_block on the loss.  With a
+        disabled tracer every obs call is the production no-op, so the
+        two variants differ ONLY by tracing overhead."""
+        state = jax.tree_util.tree_map(jnp.copy, state0)
+        seen, epoch, m = 0, 0, None
+        t0 = time.monotonic()
+        while seen < n_steps:
+            pipe.set_epoch(epoch)
+            for batch, n, _fused, _ex in tracer.wrap_iter(
+                    "data_wait", pipe.macro_batches(1)):
+                with tracer.span("step_dispatch", step=seen + 1, n=n):
+                    state, m = step(state, batch)
+                tracer.block(m["loss"], step=seen + 1, n=n)
+                seen += 1
+                if seen == n_steps:
+                    break
+            epoch += 1
+        float(jax.device_get(m["loss"]))  # completion barrier, both runs
+        dt = time.monotonic() - t0
+        del state
+        return n_steps / dt
+
+    best = {"untraced": 0.0, "traced": 0.0}
+    breakdown = detector = tracer = None
+    for _ in range(max(1, repeats)):
+        best["untraced"] = max(best["untraced"],
+                               timed_loop(pipes["untraced"], off))
+        tracer = Tracer(enabled=True)
+        detector = RegressionDetector()
+        breakdown = StepBreakdown(on_step=detector.observe)
+        tracer.add_listener(breakdown.feed)
+        pipes["traced"]._tracer = tracer
+        best["traced"] = max(best["traced"],
+                             timed_loop(pipes["traced"], tracer))
+        breakdown.close()
+
+    overhead_pct = (best["untraced"] / best["traced"] - 1.0) * 100
+    records = tracer.records()
+    chrome = to_chrome_trace(records)
+    schema_ok = bool(chrome["traceEvents"]) and all(
+        k in ev for ev in chrome["traceEvents"]
+        for k in ("name", "ph", "ts", "pid", "tid"))
+    trace_path = None
+    if out_path:
+        trace_path = out_path.rsplit(".", 1)[0] + ".trace.json"
+        write_chrome_trace(records, trace_path)
+
+    result = {
+        "metric": "trace_smoke",
+        "model": args.model,
+        "batch_size": args.train_batch_size,
+        "seq_len": args.max_seq_len,
+        "steps": n_steps,
+        "repeats": repeats,
+        "pipeline": pipes["traced"].mode,
+        "devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+        "dtype": args.dtype,
+        "untraced_steps_per_sec": round(best["untraced"], 2),
+        "traced_steps_per_sec": round(best["traced"], 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "tolerance_pct": tolerance,
+        "spans_recorded": len(records),
+        "chrome_schema_ok": schema_ok,
+        "chrome_export": trace_path,
+        "regress_events": (detector.events if detector else []),
+        "breakdown": breakdown.summary() if breakdown else None,
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, out_path)
+    print(json.dumps({k: v for k, v in result.items()
+                      if k != "breakdown"}))
+    if not schema_ok:
+        sys.exit("trace smoke FAILED: Chrome-trace export is missing "
+                 f"required event keys — see {trace_path}")
+    if overhead_pct > tolerance:
+        sys.exit(f"trace smoke FAILED: tracing costs {overhead_pct:.2f}% "
+                 f"steps/s (tolerance {tolerance}%) — see {out_path}")
+
+
 def main() -> None:
     argv = sys.argv[1:]
+    if "--trace" in argv:
+        # like --pipeline: a bench smoke intercept, not the Args.trace
+        # bool (a traced HEADLINE run is `--trace true` on the ordinary
+        # entrypoints; the bench's own flag is the overhead gate).  The
+        # Args-style boolean value is tolerated — `--trace true` runs the
+        # smoke, `--trace false` is a no-op — so the README's flag shape
+        # works on every entrypoint including this one.
+        i = argv.index("--trace")
+        argv.pop(i)
+        enabled = True
+        if i < len(argv) and argv[i].lower() in ("true", "false", "1", "0"):
+            enabled = argv.pop(i).lower() in ("true", "1")
+        if enabled:
+            return trace_smoke(argv)
     if "--pipeline" in argv:
         from pdnlp_tpu.utils.config import pop_cli_flag
 

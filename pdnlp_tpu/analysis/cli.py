@@ -30,7 +30,7 @@ def default_paths(root: str = ".") -> List[str]:
     every strategy entrypoint, and the bench/serve CLIs."""
     names = ["pdnlp_tpu", "scripts", "bench.py", "serve_tpu.py",
              "predict_tpu.py", "pretrain-tpu.py", "single-tpu-cls.py",
-             "test_tpu.py", "lint_tpu.py"]
+             "test_tpu.py", "lint_tpu.py", "trace_tpu.py"]
     out = [os.path.join(root, n) for n in names
            if os.path.exists(os.path.join(root, n))]
     out += sorted(glob.glob(os.path.join(root, "multi-tpu-*.py")))

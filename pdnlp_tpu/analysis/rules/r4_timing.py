@@ -33,8 +33,11 @@ _SYNC_CALLS = {
 
 #: method names treated as barriers.  Deliberately NOT `join`/`get`: they
 #: also name str.join/dict.get, and a timing loop that merely formats a log
-#: line must not be exempted by its own formatting.
-_SYNC_METHODS = {"item", "block_until_ready", "tolist", "numpy", "result"}
+#: line must not be exempted by its own formatting.  `block` is the obs
+#: tracer's barrier (`Span.block`/`Tracer.block` wraps block_until_ready in
+#: a device_block span) — the sanctioned fix for traced timing windows.
+_SYNC_METHODS = {"item", "block_until_ready", "tolist", "numpy", "result",
+                 "block"}
 
 
 @register
@@ -42,7 +45,9 @@ class UnblockedTiming(Rule):
     rule_id = "R4"
     name = "unblocked-async-timing"
     hint = ("call jax.block_until_ready(out) — or fetch a value with "
-            "float(jax.device_get(x)) — before reading the second timestamp")
+            "float(jax.device_get(x)) — before reading the second "
+            "timestamp; inside an obs span, sp.block(out) records the "
+            "barrier as its own device_block span (pdnlp_tpu.obs.trace)")
 
     def check(self, mod: ModuleInfo) -> Iterator[Finding]:
         if "jax" not in mod.aliases and not any(

@@ -157,12 +157,24 @@ class InputPipeline:
 
     def __init__(self, loader, put: Optional[Callable] = None,
                  put_fused: Optional[Callable] = None,
-                 stats: Optional[TransportStats] = None):
+                 stats: Optional[TransportStats] = None, tracer=None):
         self.loader = loader
         self.put = put or (lambda b: b)
         self.put_fused = put_fused or self.put
         self.stats = stats or TransportStats()
         self.stats.mode = self.mode
+        # obs tracer for h2d_put spans; None resolves to the process-global
+        # tracer LAZILY (the Trainer configures it from --trace after the
+        # pipeline is built)
+        self._tracer = tracer
+
+    @property
+    def tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from pdnlp_tpu.obs.trace import get_tracer
+
+        return get_tracer()
 
     def __len__(self) -> int:
         return len(self.loader)
@@ -198,13 +210,15 @@ class SyncPipeline(InputPipeline):
 
     def macro_batches(self, fuse: int = 1):
         stage = _MacroStage(fuse)
+        tr = self.tracer
         for host, n, fused, ex in host_macro_batches(self.loader, fuse,
                                                      stage):
             put = self.put_fused if fused else self.put
             # deliberately times HOST seconds blocked in the upload (the
             # put-wait metric), not device compute — no barrier wanted
             t0 = time.perf_counter()
-            dev = put(host)
+            with tr.span("h2d_put", bytes=_nbytes(host)):
+                dev = put(host)
             # jaxlint: disable=R4 — put-wait is a host metric by design
             self.stats.record_upload(_nbytes(host), time.perf_counter() - t0)
             if fused:
@@ -238,6 +252,7 @@ class DevicePrefetchPipeline(InputPipeline):
 
         def worker():
             try:
+                tr = self.tracer
                 stage = _MacroStage(fuse)
                 for host, n, fused, ex in host_macro_batches(
                         self.loader, fuse, stage):
@@ -249,7 +264,10 @@ class DevicePrefetchPipeline(InputPipeline):
                     self.stats.put_started()
                     put = self.put_fused if fused else self.put
                     t0 = time.perf_counter()
-                    dev = put(host)
+                    # span recorded from THIS worker thread: the export
+                    # shows the upload overlapping the step on its own tid
+                    with tr.span("h2d_put", bytes=_nbytes(host)):
+                        dev = put(host)
                     self.stats.record_upload(
                         _nbytes(host),
                         # jaxlint: disable=R4 — put-wait is a host metric
@@ -301,8 +319,8 @@ class DeviceResidentPipeline(InputPipeline):
 
     def __init__(self, loader, put: Optional[Callable] = None,
                  put_fused: Optional[Callable] = None, mesh=None,
-                 stats: Optional[TransportStats] = None):
-        super().__init__(loader, put, put_fused, stats)
+                 stats: Optional[TransportStats] = None, tracer=None):
+        super().__init__(loader, put, put_fused, stats, tracer)
         if loader.encoded is None:
             raise ValueError(
                 "device-resident pipeline needs the loader's EncodedDataset "
@@ -314,12 +332,16 @@ class DeviceResidentPipeline(InputPipeline):
         self.rows = loader.batch_size
         self._gathers: Dict[int, Callable] = {}
         enc = loader.encoded
+        nbytes = sum(v.nbytes for v in enc.arrays.values())
         t0 = time.perf_counter()
-        self.arrays = {k: self._place(v) for k, v in enc.arrays.items()}
-        jax.block_until_ready(list(self.arrays.values()))
-        self.stats.record_upload(
-            sum(v.nbytes for v in enc.arrays.values()),
-            time.perf_counter() - t0, in_loop=False)
+        # the one-time residency upload: an amortized h2d_put span (the
+        # trace shows the ~14 MB upload once, then silence every step)
+        with self.tracer.span("h2d_put", bytes=nbytes, in_loop=False,
+                              what="resident_dataset"):
+            self.arrays = {k: self._place(v) for k, v in enc.arrays.items()}
+            jax.block_until_ready(list(self.arrays.values()))
+        self.stats.record_upload(nbytes, time.perf_counter() - t0,
+                                 in_loop=False)
 
     # ------------------------------------------------------------ placement
     def _place(self, v: np.ndarray):
@@ -415,6 +437,7 @@ class DeviceResidentPipeline(InputPipeline):
         gather_f = self._gather(k) if n_fused else None
         gather_1 = self._gather(1) if n_tail else None
         t0 = time.perf_counter()
+        tr0 = self.tracer.now()
         segments = []
         if n_fused:
             segments.append((gather_f, k, n_fused,
@@ -432,6 +455,12 @@ class DeviceResidentPipeline(InputPipeline):
                              self._replicate(
                                  counts[n_fused * k:].reshape(n_tail, 1)),
                              counts[n_fused * k:].reshape(n_tail, 1)))
+        # the per-epoch permutation-index upload (~40 KB): the ONLY
+        # steady-state transport resident mode pays — one amortized
+        # h2d_put span per epoch in the trace
+        self.tracer.record("h2d_put", tr0, self.tracer.now(),
+                           bytes=padded.nbytes + counts.nbytes + 4,
+                           in_loop=False, what="epoch_indices")
         self.stats.record_upload(
             padded.nbytes + counts.nbytes + 4,
             # jaxlint: disable=R4 — host wait of the index upload, by design
@@ -449,7 +478,8 @@ class DeviceResidentPipeline(InputPipeline):
 def build_pipeline(args, loader, put: Optional[Callable] = None,
                    put_fused: Optional[Callable] = None, mesh=None,
                    allow_resident: bool = True,
-                   stats: Optional[TransportStats] = None) -> InputPipeline:
+                   stats: Optional[TransportStats] = None,
+                   tracer=None) -> InputPipeline:
     """The mode decision, in one place.
 
     ``args.pipeline``: ``auto`` (default) picks ``resident`` when eligible,
@@ -492,5 +522,6 @@ def build_pipeline(args, loader, put: Optional[Callable] = None,
            "prefetch": DevicePrefetchPipeline,
            "sync": SyncPipeline}[mode]
     if cls is DeviceResidentPipeline:
-        return cls(loader, put, put_fused, mesh=mesh, stats=stats)
-    return cls(loader, put, put_fused, stats=stats)
+        return cls(loader, put, put_fused, mesh=mesh, stats=stats,
+                   tracer=tracer)
+    return cls(loader, put, put_fused, stats=stats, tracer=tracer)

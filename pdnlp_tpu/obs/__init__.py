@@ -1,0 +1,26 @@
+"""``pdnlp_tpu.obs`` — structured step tracing, phase breakdown, and
+regression detection.
+
+The attribution layer the ROADMAP's "as fast as the hardware allows" needs
+before any further hot-path work: a dispatch/block-aware span tracer
+(``trace``), the canonical per-step phase taxonomy + aggregator
+(``phases``), Chrome-trace/JSONL exporters (``export``), and the EWMA
+step-time regression detector + trace differ (``regress``).  The
+``trace_tpu.py`` CLI at the repo root fronts the offline half
+(``summarize`` / ``diff`` / ``export``).
+
+Off by default: entrypoints enable it with ``--trace`` (spans land under
+``<output_dir>/trace/trace_proc<i>.jsonl``); ``bench.py --trace`` pins the
+enabled-mode overhead under its tolerance.
+"""
+from pdnlp_tpu.obs.phases import PHASES, StepBreakdown, format_table
+from pdnlp_tpu.obs.regress import RegressionDetector, diff_breakdowns
+from pdnlp_tpu.obs.trace import (
+    Span, Tracer, configure, configure_from_args, get_tracer,
+)
+
+__all__ = [
+    "PHASES", "StepBreakdown", "format_table",
+    "RegressionDetector", "diff_breakdowns",
+    "Span", "Tracer", "configure", "configure_from_args", "get_tracer",
+]

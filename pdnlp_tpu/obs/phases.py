@@ -1,0 +1,207 @@
+"""The canonical per-step phase taxonomy + the per-step aggregator.
+
+Every traced layer names its spans out of ONE vocabulary, so a trace from
+the trainer, the input pipeline, and the checkpoint writer composes into a
+single per-step breakdown — and ``trace_tpu.py diff`` can compare any two
+runs phase by phase:
+
+====================  =====================================================
+phase                 host-side meaning
+====================  =====================================================
+``data_wait``         blocked obtaining the next batch (collation, the
+                      prefetch queue, the resident gather dispatch)
+``h2d_put``           blocked inside a host->device upload (``put``); the
+                      resident pipeline's amortized uploads carry
+                      ``in_loop=False``
+``step_dispatch``     enqueueing the jitted train step (async: this is
+                      dispatch latency, NOT compute)
+``device_block``      ``block_until_ready`` on the step's output — where
+                      device compute time actually surfaces on the host
+``eval``              the in-loop dev pass
+``ckpt_save``         resume-snapshot / checkpoint writes
+``log``               formatting + printing the loss line
+====================  =====================================================
+
+:class:`StepBreakdown` folds a span stream into per-step phase totals and
+summarizes mean/p50/p95 per phase.  It is a tracer *listener* (feed it via
+``tracer.add_listener(breakdown.feed)``): a ``device_block`` span closes
+the current step — the traced loop emits exactly one per optimizer-step
+group — so fused K-step dispatches aggregate correctly through the
+record's ``n`` attribute.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+PHASES = ("data_wait", "h2d_put", "step_dispatch", "device_block",
+          "eval", "ckpt_save", "log")
+
+#: the phase that marks "this optimizer-step group is finished" in a span
+#: stream (the traced loop's per-step barrier)
+STEP_END_PHASE = "device_block"
+
+
+def _percentile(sorted_vals: Sequence[float], p: float) -> float:
+    """Exact percentile over a sorted list (numpy-free: the CLI must run
+    without the training stack)."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    k = (len(sorted_vals) - 1) * (p / 100.0)
+    lo = int(k)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = k - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+class StepBreakdown:
+    """Per-step phase accumulator -> per-phase mean/p50/p95.
+
+    ``feed(record)`` accepts tracer span records; per-STEP totals (a step
+    may contain several spans of one phase) are closed by the
+    ``device_block`` record and become one observation per phase.  Spans
+    whose name is not a known phase are ignored — serve traces flow through
+    the same tracer with their own vocabulary.  Phase seconds are SELF
+    time: a phase span nested inside another phase span (same thread,
+    contained interval) has its duration subtracted from the enclosing
+    one, so sync mode's in-``next`` upload counts as ``h2d_put``, not as
+    ``h2d_put`` + ``data_wait`` twice.  ``feed`` is thread-safe — the
+    prefetch worker's spans arrive on its own thread.
+
+    ``on_step(step, phases, wall)`` fires as each step closes — the
+    regression detector's input — with ``step`` the global step counter
+    (from the ``device_block`` record's ``step`` attr when present, else a
+    running count), ``phases`` the step's phase->seconds dict, and ``wall``
+    the step's total traced seconds.
+    """
+
+    def __init__(self, on_step: Optional[Callable[[int, Dict[str, float],
+                                                   float], None]] = None):
+        self.on_step = on_step
+        self.steps = 0            # optimizer steps (fused groups count n)
+        self.groups = 0           # dispatch groups (= observations)
+        self._current: Dict[str, float] = {}
+        self._per_phase: Dict[str, List[float]] = {}
+        self._count = 0
+        # feed() runs on whichever thread RECORDED the span (tracer
+        # listeners fire in-line) — the prefetch worker's h2d_put races the
+        # main thread's step spans without this
+        self._lock = threading.Lock()
+        self._children: Dict[int, List] = {}  # tid -> [(t0, t1, dur, depth)]
+
+    # ------------------------------------------------------------- feeding
+    def feed(self, record: Dict) -> None:
+        name = record.get("name")
+        if name not in PHASES:
+            return
+        full = float(record.get("dur", 0.0))
+        dur = full
+        depth = int(record.get("depth", 0))
+        tid = record.get("tid", 0)
+        t0 = float(record.get("t0", 0.0))
+        t1 = t0 + full
+        with self._lock:
+            # SELF time, not inclusive time: a phase span can lexically
+            # contain another phase span on its thread (sync mode's
+            # h2d_put runs inside the data_wait span around ``next``), and
+            # spans complete child-first — so subtract already-fed DEEPER
+            # spans this one contains, and each second lands in exactly
+            # one phase instead of being double-counted.
+            pending = self._children.get(tid)
+            if pending:
+                kept = []
+                for c in pending:
+                    if c[3] > depth and c[0] >= t0 and c[1] <= t1:
+                        dur -= c[2]
+                    else:
+                        kept.append(c)
+                self._children[tid] = kept
+            if depth > 0:  # only nested spans can be someone's child
+                # the FULL duration: a grandparent subtracts the whole
+                # consumed subtree exactly once
+                self._children.setdefault(tid, []).append(
+                    (t0, t1, full, depth))
+                del self._children[tid][:-64]  # bound orphaned children
+            self._current[name] = self._current.get(name, 0.0) \
+                + max(0.0, dur)
+            if name == STEP_END_PHASE:
+                attrs = record.get("attrs") or {}
+                self._close_step(attrs.get("step"), int(attrs.get("n", 1)))
+
+    def record(self, phase: str, seconds: float) -> None:
+        """Direct accumulation into the open step (tests / non-span use)."""
+        with self._lock:
+            self._current[phase] = self._current.get(phase, 0.0) \
+                + float(seconds)
+
+    def end_step(self, step: Optional[int] = None, n: int = 1) -> None:
+        """Close the open step explicitly (loops without a block span)."""
+        with self._lock:
+            self._close_step(step, n)
+
+    def _close_step(self, step: Optional[int], n: int) -> None:
+        # caller holds self._lock
+        phases = self._current
+        self._current = {}
+        if n > 0:  # n=0 marks a trailing partial flush, not a real step
+            self.groups += 1
+            self.steps += int(n)
+        self._count = int(step) if step is not None else self._count + n
+        for phase, sec in phases.items():
+            self._per_phase.setdefault(phase, []).append(sec)
+        if self.on_step is not None:
+            self.on_step(self._count, phases, sum(phases.values()))
+
+    def close(self) -> None:
+        """Flush a trailing partial step (spans after the last barrier)."""
+        with self._lock:
+            if self._current:
+                self._close_step(None, 0)
+
+    # ------------------------------------------------------------- summary
+    def summary(self) -> Dict:
+        """JSON-ready per-phase stats: seconds mean/p50/p95/total/count,
+        plus share of the traced wall time."""
+        phases = {}
+        grand = sum(sum(v) for v in self._per_phase.values()) or 1.0
+        for phase, vals in sorted(self._per_phase.items(),
+                                  key=lambda kv: -sum(kv[1])):
+            s = sorted(vals)
+            total = sum(vals)
+            phases[phase] = {
+                "count": len(vals),
+                "total_sec": round(total, 6),
+                "mean_sec": round(total / len(vals), 9),
+                "p50_sec": round(_percentile(s, 50), 9),
+                "p95_sec": round(_percentile(s, 95), 9),
+                "share": round(total / grand, 4),
+            }
+        return {"steps": self.steps, "groups": self.groups, "phases": phases}
+
+    @staticmethod
+    def from_records(records: Sequence[Dict]) -> "StepBreakdown":
+        """Rebuild a breakdown from an exported span stream (the CLI's
+        ``summarize``/``diff`` path)."""
+        bd = StepBreakdown()
+        for rec in records:
+            bd.feed(rec)
+        bd.close()
+        return bd
+
+
+def format_table(summary: Dict) -> str:
+    """The phase table: one aligned text block (``trace_tpu.py summarize``
+    and the end-of-train print share it)."""
+    header = (f"{'phase':<14} {'count':>7} {'total_s':>10} {'mean_ms':>10} "
+              f"{'p50_ms':>10} {'p95_ms':>10} {'share':>7}")
+    lines = [header, "-" * len(header)]
+    for phase, s in summary.get("phases", {}).items():
+        lines.append(
+            f"{phase:<14} {s['count']:>7d} {s['total_sec']:>10.3f} "
+            f"{s['mean_sec'] * 1e3:>10.3f} {s['p50_sec'] * 1e3:>10.3f} "
+            f"{s['p95_sec'] * 1e3:>10.3f} {s['share']:>6.1%}")
+    lines.append(f"steps: {summary.get('steps', 0)}  "
+                 f"dispatch groups: {summary.get('groups', 0)}")
+    return "\n".join(lines)

@@ -1,0 +1,293 @@
+"""Span tracer — where a step's time goes, recorded without lying.
+
+The reference's entire observability story is one wall-clock print per
+epoch (``耗时：X分钟``, ``/root/reference/multi-gpu-distributed-cls.py:
+193-195``); this repo's bench layer added aggregate counters
+(``utils.metrics``, ``TransportStats``) but still no per-step timeline —
+a pipeline-mode A/B can say *that* resident is 1.07× sync, not *why*.
+
+The tracer records host-side spans into a ring buffer:
+
+- ``span(name, **attrs)`` — context manager; monotonic timestamps
+  (``perf_counter``), thread-aware, nesting tracked through a per-thread
+  stack so exporters can reconstruct the call tree;
+- **async-aware by construction**: JAX dispatch returns at *enqueue*, so a
+  span around a jitted call measures dispatch latency, not compute (the
+  hazard jaxlint R4 flags).  The API therefore splits the two:
+  ``span("step_dispatch")`` wraps the call, and ``Span.block(value)`` /
+  ``Tracer.block(value)`` opens a SEPARATE ``device_block`` span around
+  ``jax.block_until_ready`` — device time is attributed to the block span,
+  never smeared into the dispatch span.  On a disabled tracer ``block`` is
+  a no-op (no hidden barrier sneaks into the untraced hot loop);
+- **ring buffer**: a ``deque(maxlen=capacity)`` holds the most recent
+  spans; a days-long run cannot grow without bound, and the recent window
+  is what a regression hunt wants anyway;
+- **per-process files**: ``flush()`` writes ``trace_proc<i>.jsonl`` under
+  the configured directory — each rank of a gang writes its own file, no
+  cross-process coordination in the hot path;
+- **off by default, cheap when on**: a disabled tracer's ``span`` returns
+  one shared no-op object (no allocation); enabled spans cost two
+  ``perf_counter`` reads and a deque append (``bench.py --trace`` pins the
+  end-to-end overhead under its tolerance).
+
+Listeners (``add_listener``) receive each finished span record — this is
+how :class:`~pdnlp_tpu.obs.phases.StepBreakdown` and, through it, the
+:class:`~pdnlp_tpu.obs.regress.RegressionDetector` ride the trace stream
+without a second set of timing calls in the loop.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, Iterator, List, Optional
+
+
+class Span:
+    """One open span: ``with tracer.span("step_dispatch") as sp: ...``."""
+
+    __slots__ = ("_tracer", "name", "attrs", "t0", "_tid", "_depth")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Dict):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def set(self, **attrs) -> "Span":
+        """Attach attributes after entry (e.g. bytes counted inside)."""
+        self.attrs.update(attrs)
+        return self
+
+    def block(self, value, name: str = "device_block", **attrs):
+        """Materialize ``value`` inside a CHILD span: the device-time half
+        of an async dispatch, recorded separately so the enclosing span
+        keeps measuring enqueue only.  Returns ``value``."""
+        return self._tracer.block(value, name=name, **attrs)
+
+    def __enter__(self) -> "Span":
+        tr = self._tracer
+        self._tid, stack = tr._thread_state()
+        self._depth = len(stack)
+        stack.append(self)
+        self.t0 = tr.clock()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        tr = self._tracer
+        t1 = tr.clock()
+        _, stack = tr._thread_state()
+        if stack and stack[-1] is self:
+            stack.pop()
+        tr._record(self.name, self.t0, t1, self._tid, self._depth, self.attrs)
+
+
+class _NullSpan:
+    """Shared no-op span for the disabled tracer: zero allocation per use."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+    def set(self, **attrs):
+        return self
+
+    def block(self, value, name: str = "device_block", **attrs):
+        # deliberately NO barrier: tracing off must not alter the loop's
+        # async-dispatch discipline
+        return value
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Low-overhead span recorder (see module docstring).
+
+    ``enabled=False`` makes every API a near-free no-op — entrypoints build
+    one process-global tracer via :func:`configure` and leave the
+    instrumentation in place unconditionally.
+    """
+
+    def __init__(self, out_dir: Optional[str] = None, *,
+                 enabled: bool = True, capacity: int = 100_000,
+                 process_index: Optional[int] = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.enabled = bool(enabled)
+        self.out_dir = out_dir
+        self.capacity = int(capacity)
+        self.clock = clock
+        self.pid = process_index
+        self._records: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._tids: Dict[int, int] = {}  # thread ident -> small stable int
+        self._listeners: List[Callable[[Dict], None]] = []
+
+    # --------------------------------------------------------------- spans
+    def span(self, name: str, **attrs):
+        """Context manager timing a host-side region.  Disabled tracer:
+        returns the shared no-op span."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return Span(self, name, attrs)
+
+    def block(self, value, name: str = "device_block", **attrs):
+        """``jax.block_until_ready(value)`` inside its own span — the
+        device-time attribution primitive (and jaxlint R4's sanctioned
+        barrier for traced timing windows).  No-op when disabled: tracing
+        off never injects a barrier.  Returns ``value``."""
+        if not self.enabled or value is None:
+            return value
+        import jax
+
+        with self.span(name, **attrs):
+            jax.block_until_ready(value)
+        return value
+
+    def record(self, name: str, t0: float, t1: float, **attrs) -> None:
+        """Record a span from explicit timestamps (tracer-clock domain) —
+        for waits measured elsewhere, e.g. the batcher's queue wait."""
+        if not self.enabled:
+            return
+        tid, stack = self._thread_state()
+        self._record(name, t0, t1, tid, len(stack), attrs)
+
+    def now(self) -> float:
+        return self.clock()
+
+    def wrap_iter(self, name: str, it: Iterable, **attrs) -> Iterator:
+        """Yield from ``it``, timing each ``next`` in a ``name`` span — how
+        the train loop attributes ``data_wait`` without restructuring its
+        ``for``.  Disabled: plain passthrough."""
+        if not self.enabled:
+            yield from it
+            return
+        it = iter(it)
+        while True:
+            with self.span(name, **attrs):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+            yield item
+
+    # ----------------------------------------------------------- recording
+    def _thread_state(self):
+        local = self._local
+        tid = getattr(local, "tid", None)
+        if tid is None:
+            ident = threading.get_ident()
+            with self._lock:
+                tid = self._tids.setdefault(ident, len(self._tids))
+            local.tid = tid
+            local.stack = []
+        return tid, local.stack
+
+    def _record(self, name, t0, t1, tid, depth, attrs) -> None:
+        rec = {"name": name, "t0": t0, "dur": t1 - t0, "tid": tid,
+               "depth": depth}
+        if attrs:
+            rec["attrs"] = attrs
+        with self._lock:
+            self._records.append(rec)
+        for fn in self._listeners:
+            fn(rec)
+
+    def records(self) -> List[Dict]:
+        """Snapshot of the ring buffer (oldest first)."""
+        with self._lock:
+            return list(self._records)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+    # ----------------------------------------------------------- listeners
+    def add_listener(self, fn: Callable[[Dict], None]) -> None:
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[Dict], None]) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    # --------------------------------------------------------------- files
+    def trace_path(self) -> Optional[str]:
+        if not self.out_dir:
+            return None
+        pid = self.pid
+        if pid is None:
+            pid = 0
+        return os.path.join(self.out_dir, f"trace_proc{pid}.jsonl")
+
+    def flush(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the ring buffer as compact JSONL (one span per line);
+        returns the path written, or None when there is nowhere to write.
+        The buffer is kept — flush is a snapshot, not a drain."""
+        path = path or self.trace_path()
+        if not self.enabled or path is None:
+            return None
+        from pdnlp_tpu.obs.export import write_jsonl
+
+        write_jsonl(self.records(), path, process_index=self.pid or 0)
+        return path
+
+
+def _resolve_process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+# process-global tracer: instrumentation sites resolve it lazily, so one
+# configure() call at entrypoint setup turns every layer's spans on
+_default = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    return _default
+
+
+def configure(out_dir: Optional[str] = None, *, enabled: bool = True,
+              capacity: int = 100_000,
+              process_index: Optional[int] = None) -> Tracer:
+    """Replace the process-global tracer.  Idempotent in the way wiring
+    needs: reconfiguring with identical settings keeps the live tracer
+    (and its buffered spans); any change builds a fresh one."""
+    global _default
+    if process_index is None and enabled:
+        process_index = _resolve_process_index()
+    same = (_default.enabled == enabled and _default.out_dir == out_dir
+            and _default.capacity == int(capacity)
+            and (_default.pid == process_index or not enabled))
+    if not same:
+        _default = Tracer(out_dir, enabled=enabled, capacity=capacity,
+                          process_index=process_index)
+    return _default
+
+
+def configure_from_args(args) -> Tracer:
+    """``--trace`` / ``--trace_dir`` -> the process-global tracer.  Every
+    Trainer/pipeline/engine construction funnels through here, so any
+    entrypoint that parses ``Args`` gets tracing for free.
+
+    The args are the single source of truth: ``trace=False`` RESETS the
+    global tracer to disabled (a sweep's untraced run after a traced one
+    must not inherit spans).  Code that configures the tracer explicitly
+    and wants it to survive construction of an untraced-args component
+    should pass that tracer via the component's ``tracer=`` parameter
+    instead of relying on the global."""
+    enabled = bool(getattr(args, "trace", False))
+    out_dir = getattr(args, "trace_dir", None)
+    if enabled and not out_dir:
+        out_dir = os.path.join(getattr(args, "output_dir", "output"), "trace")
+    return configure(out_dir if enabled else None, enabled=enabled)

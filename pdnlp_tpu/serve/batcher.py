@@ -276,6 +276,16 @@ class DynamicBatcher:
         t0 = time.monotonic()
         for r in batch:
             self.metrics.queue_wait_ms.observe((t0 - r.submitted) * 1e3)
+        # one queue_wait span per flushed batch, duration = its OLDEST
+        # request's wait (the flush-policy-visible latency); recorded in
+        # the tracer's clock domain with explicit timestamps since the
+        # wait began before this call
+        tr = self.engine.tracer
+        if tr.enabled:
+            now = tr.now()
+            oldest = max(t0 - r.submitted for r in batch)
+            tr.record("queue_wait", now - oldest, now, bucket=bucket,
+                      rows=len(batch))
         try:
             rows = self.max_batch_size  # already padded to the mesh multiple
             logits = self.engine.infer_ids([r.ids for r in batch], bucket,

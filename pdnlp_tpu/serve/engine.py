@@ -44,14 +44,25 @@ from pdnlp_tpu.train.precision import resolve_dtype
 
 class InferenceEngine:
     def __init__(self, args, tokenizer: Optional[WordPieceTokenizer] = None,
-                 *, mesh=None, metrics: Optional[ServeMetrics] = None):
+                 *, mesh=None, metrics: Optional[ServeMetrics] = None,
+                 tracer=None):
         """``args`` supplies model/dtype/vocab knobs (an ``utils.config.Args``).
 
         ``mesh=None`` means plain ``jax.jit`` on the default device — the
         exact forward ``predict_tpu.py`` always ran.  With a mesh, batches
         shard along ``data`` and batch rows are padded up to a multiple of
         the axis size (``rows_multiple``).
+
+        ``tracer`` (``pdnlp_tpu.obs``): the engine emits one span per
+        executed batch — ``compile`` for a first-seen ``(seq, rows)`` shape
+        (the trace shows exactly when/where retraces happen), ``forward``
+        for a cache hit.  Defaults to the process-global tracer, configured
+        from ``args`` so ``serve_tpu.py --trace true`` just works.
         """
+        from pdnlp_tpu.obs.trace import configure_from_args
+
+        self.tracer = tracer if tracer is not None \
+            else configure_from_args(args)
         self.args = args
         self.tokenizer = tokenizer or WordPieceTokenizer(get_or_build_vocab(args))
         self.cfg = get_config(args.model, vocab_size=self.tokenizer.vocab_size,
@@ -125,9 +136,11 @@ class InferenceEngine:
         key = (int(seq), int(rows))
         if key in self._seen_shapes:
             self.metrics.cache_hits.inc()
+            span_name = "forward"
         else:
             self.metrics.cache_misses.inc()
             self._seen_shapes.add(key)
+            span_name = "compile"  # first call at this shape traces
         fwd = {k: batch[k] for k in ("input_ids", "attention_mask",
                                      "token_type_ids")}
         if self.mesh is not None:
@@ -136,8 +149,12 @@ class InferenceEngine:
             sh = batch_sharding(self.mesh)
             fwd = {k: jax.make_array_from_process_local_data(sh, v)
                    for k, v in fwd.items()}
-        logits = self._jit_forward(self.params, fwd)
-        return np.asarray(jax.device_get(logits))
+        # the device_get fetch inside the span IS the completion barrier:
+        # serve spans measure request-visible latency, dispatch + compute
+        with self.tracer.span(span_name, seq=int(seq), rows=int(rows)):
+            logits = self._jit_forward(self.params, fwd)
+            out = np.asarray(jax.device_get(logits))
+        return out
 
     def infer_ids(self, id_lists: Sequence[Sequence[int]], seq_len: int,
                   rows: int = 0) -> np.ndarray:

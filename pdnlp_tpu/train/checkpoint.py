@@ -17,6 +17,7 @@ key, enabling true mid-training resume (the reference cannot resume).
 from __future__ import annotations
 
 import os
+import re
 from typing import Any, Dict, Optional
 
 import jax
@@ -144,10 +145,50 @@ def load_state(path: str, like_state: Dict[str, Any]) -> Dict[str, Any]:
     return load(path, like_state)
 
 
+_STEP_RE = re.compile(r"[-_.](\d+)$")
+
+
+def _filename_step(path: str, pattern: str) -> Optional[tuple]:
+    """``(stem, step)`` for a step-family checkpoint name — a TRAILING
+    integer set off by ``-``/``_``/``.`` right before the suffix
+    (``ckpt-1500.msgpack`` -> ``("ckpt", 1500)``) — or None.  Interior or
+    attached digits are NOT steps: ``zero2-cls`` and ``pretrained-e5``
+    name a strategy and an epoch tag, not a step counter."""
+    base = os.path.basename(path)
+    if base.endswith(pattern):
+        base = base[:len(base) - len(pattern)]
+    m = _STEP_RE.search(base)
+    return (base[:m.start()], int(m.group(1))) if m else None
+
+
 def latest(output_dir: str, pattern: str = ".msgpack") -> Optional[str]:
-    """Newest checkpoint in a directory, or None."""
+    """Newest checkpoint in a directory, or None.
+
+    mtime alone is the wrong order key twice over: coarse-mtime
+    filesystems tie checkpoints written within the same second, and a
+    ``cp -p`` restore resurrects old timestamps wholesale — after which
+    "newest mtime" silently serves a stale file.  When every candidate
+    belongs to ONE step family (same stem, trailing ``-<step>`` before
+    the suffix), the step ORDERS them (mtime only breaks step ties);
+    any mixed-family directory falls back to mtime with deterministic
+    name tie-breaks, so `pretrained-e5.msgpack` can never outrank a
+    newer `zero2-cls.msgpack` on its epoch digit.
+
+    Deliberate consequence: within one family the highest STEP wins even
+    when a lower-step file is newer on disk — a reused output_dir whose
+    new run restarts the step counter should be cleaned (or given a new
+    dir) first, the same contract resume already has.
+    """
     if not os.path.isdir(output_dir):
         return None
     cands = [os.path.join(output_dir, f) for f in os.listdir(output_dir)
              if f.endswith(pattern)]
-    return max(cands, key=os.path.getmtime) if cands else None
+    if not cands:
+        return None
+    steps = {c: _filename_step(c, pattern) for c in cands}
+    if all(s is not None for s in steps.values()) \
+            and len({s[0] for s in steps.values()}) == 1:
+        return max(cands, key=lambda c: (steps[c][1], os.path.getmtime(c)))
+    return max(cands, key=lambda c: (os.path.getmtime(c),
+                                     steps[c][1] if steps[c] else -1,
+                                     os.path.basename(c)))

@@ -68,9 +68,15 @@ def setup_pipeline(args, loader, put=None, put_fused=None, mesh=None,
     """The input pipeline for a wired loader (``data.pipeline``): resident
     (split held in HBM, zero steady-state transport) / double-buffered
     prefetch / sync behind ``--pipeline``; shared by the strategy runners
-    and the single-device entrypoint so the mode decision can't drift."""
-    from pdnlp_tpu.data.pipeline import build_pipeline
+    and the single-device entrypoint so the mode decision can't drift.
 
+    Configures the obs tracer from ``--trace`` FIRST: the resident
+    pipeline's one-time residency upload happens inside ``build_pipeline``
+    and must land in the trace, not precede it."""
+    from pdnlp_tpu.data.pipeline import build_pipeline
+    from pdnlp_tpu.obs.trace import configure_from_args
+
+    configure_from_args(args)
     return build_pipeline(args, loader, put=put, put_fused=put_fused,
                           mesh=mesh, allow_resident=allow_resident)
 

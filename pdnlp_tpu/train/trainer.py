@@ -73,6 +73,7 @@ class Trainer:
         multi_step: Optional[Callable] = None,
         put_fused: Optional[Callable] = None,
         pipeline=None,
+        tracer=None,
     ):
         self.args = args
         self.cfg = cfg
@@ -92,6 +93,18 @@ class Trainer:
         # back to the classic put-in-loop path instead of training on the
         # wrong data.
         self.pipeline = pipeline
+        # obs span tracer (pdnlp_tpu.obs): --trace configures the process-
+        # global tracer here, so EVERY entrypoint that builds a Trainer
+        # gets phase spans + the step breakdown + the regression detector
+        # without its own wiring.  Disabled (the default) it is a shared
+        # no-op object, not a branch in the hot loop.
+        from pdnlp_tpu.obs import trace as _trace
+
+        self.tracer = tracer if tracer is not None \
+            else _trace.configure_from_args(args)
+        # per-phase mean/p50/p95 of the LAST train() call (None untraced) —
+        # bench.py --trace embeds it in its JSON
+        self.trace_summary = None
         self.best_accuracy = 0.0
         self._best_params = None  # device-held copy; written once at end
         # (minutes-since-train-start, dev accuracy) per in-loop eval: the
@@ -241,129 +254,184 @@ class Trainer:
         pending: Tuple[int, int, jax.Array] | None = None  # (epoch, gstep, loss)
         last_loss = None
         profiler = Profiler(getattr(args, "profile_dir", None))
-        fuse = getattr(args, "fuse_steps", 1)
-        resume_every = getattr(args, "resume_every", None)
-        heartbeat = None
-        if getattr(args, "heartbeat_interval", 0) > 0:
-            from pdnlp_tpu.parallel.watchdog import Heartbeat
+        # obs tracing: phase spans feed a per-step breakdown, which feeds
+        # the EWMA regression detector (whose smoothed rate rides the
+        # heartbeat).  tr is a no-op object when --trace is off — the
+        # span/block calls below stay in place unconditionally.
+        tr = self.tracer
+        breakdown = detector = None
+        if tr.enabled:
+            from pdnlp_tpu.obs import RegressionDetector, StepBreakdown
 
-            heartbeat = Heartbeat(args.output_dir, jax.process_index(),
-                                  args.heartbeat_interval)
-        # chaos hook for the elastic tests: PDNLP_FAULT_STEP kills rank
-        # PDNLP_FAULT_PROC at that step — but only on a fresh (non-resumed)
-        # incarnation, so the restarted gang survives
-        fault_step = int(os.environ.get("PDNLP_FAULT_STEP", "0"))
-        fault_proc = int(os.environ.get("PDNLP_FAULT_PROC", "0"))
-        examples = 0
-        if getattr(args, "warmup_compile", False):
-            self.warmup_compile(train_loader, dev_loader)
-        if getattr(args, "probe_steps", 0):
-            rate = self.probe_steps_per_sec(train_loader, args.probe_steps)
-            if rate is not None:
-                rank0_print(f"probe steps/s：{rate:.2f}")
-        # the per-step upload route: a pipeline wrapping THIS loader hands
-        # over device batches (resident: zero steady-state transport;
-        # prefetch: double-buffered upload); otherwise put runs inline (the
-        # sync fallback the jaxlint R7 baseline records)
-        use_pipe = self._use_pipeline(train_loader)
-        stage = None
-        if not use_pipe:
-            from pdnlp_tpu.data.pipeline import _MacroStage
+            detector = RegressionDetector(
+                on_event=lambda ev: rank0_print(f"[obs] {ev}"))
+            breakdown = StepBreakdown(on_step=detector.observe)
+            tr.add_listener(breakdown.feed)
+        # the listener must detach even when the loop raises (resume
+        # mismatch, fault injection, KeyboardInterrupt): a stale feed
+        # on the process-global tracer would double-count every span
+        # of the NEXT traced train() in this process
+        try:
+            fuse = getattr(args, "fuse_steps", 1)
+            resume_every = getattr(args, "resume_every", None)
+            heartbeat = None
+            if getattr(args, "heartbeat_interval", 0) > 0:
+                from pdnlp_tpu.parallel.watchdog import Heartbeat
 
-            stage = _MacroStage(fuse)
-        start = time.time()
-        self._t0 = start
-        for epoch in range(1, args.epochs + 1):
-            if gstep + len(train_loader) <= start_step:
-                # resume fast-forward, whole-epoch short-circuit: nothing in
-                # this epoch executes, so don't collate (or, in prefetch
-                # mode, upload) any of its batches — the seeded sampler
-                # makes skipping by count exact
-                gstep += len(train_loader)
-                if heartbeat is not None:
-                    heartbeat.beat()
-                continue
-            if use_pipe:
-                self.pipeline.set_epoch(epoch - 1)
-                groups = self.pipeline.macro_batches(
-                    fuse if self.multi_step is not None else 1)
-            else:
-                train_loader.set_epoch(epoch - 1)
-                groups = self._macro_batches(train_loader, fuse, stage)
-            for batch, n, fused, n_examples in groups:
-                if gstep + n <= start_step:  # already done before the restart
-                    gstep += n
-                    if heartbeat is not None:  # long fast-forwards stay live
-                        heartbeat.beat()
+                heartbeat = Heartbeat(args.output_dir, jax.process_index(),
+                                      args.heartbeat_interval)
+            # chaos hook for the elastic tests: PDNLP_FAULT_STEP kills rank
+            # PDNLP_FAULT_PROC at that step — but only on a fresh (non-resumed)
+            # incarnation, so the restarted gang survives
+            fault_step = int(os.environ.get("PDNLP_FAULT_STEP", "0"))
+            fault_proc = int(os.environ.get("PDNLP_FAULT_PROC", "0"))
+            examples = 0
+            if getattr(args, "warmup_compile", False):
+                self.warmup_compile(train_loader, dev_loader)
+            if getattr(args, "probe_steps", 0):
+                rate = self.probe_steps_per_sec(train_loader, args.probe_steps)
+                if rate is not None:
+                    rank0_print(f"probe steps/s：{rate:.2f}")
+            # the per-step upload route: a pipeline wrapping THIS loader hands
+            # over device batches (resident: zero steady-state transport;
+            # prefetch: double-buffered upload); otherwise put runs inline (the
+            # sync fallback the jaxlint R7 baseline records)
+            use_pipe = self._use_pipeline(train_loader)
+            stage = None
+            if not use_pipe:
+                from pdnlp_tpu.data.pipeline import _MacroStage
+
+                stage = _MacroStage(fuse)
+            start = time.time()
+            self._t0 = start
+            for epoch in range(1, args.epochs + 1):
+                if gstep + len(train_loader) <= start_step:
+                    # resume fast-forward, whole-epoch short-circuit: nothing in
+                    # this epoch executes, so don't collate (or, in prefetch
+                    # mode, upload) any of its batches — the seeded sampler
+                    # makes skipping by count exact
+                    gstep += len(train_loader)
+                    if heartbeat is not None:
+                        heartbeat.beat(step=gstep)
                     continue
-                if gstep < start_step:
-                    # the restored step falls inside this fused group:
-                    # executing it would re-apply updates the restored
-                    # optimizer state already contains
-                    raise ValueError(
-                        f"resume step {start_step} is not a fused-group "
-                        f"boundary under fuse_steps={fuse} (group covers "
-                        f"steps {gstep + 1}..{gstep + n}) — resume with the "
-                        "fuse_steps the snapshot was saved under, or 1")
-                if fault_step and start_step == 0 and gstep >= fault_step \
-                        and jax.process_index() == fault_proc:
-                    os._exit(13)
-                if fused:
-                    dev = batch if use_pipe else self.put_fused(batch)
-                    if stage is not None:
-                        stage.verify(batch, dev)  # aliasing guard, once
-                    self.state, metrics = self.multi_step(self.state, dev)
-                    last_loss = metrics["loss"][-1]
+                if use_pipe:
+                    self.pipeline.set_epoch(epoch - 1)
+                    groups = self.pipeline.macro_batches(
+                        fuse if self.multi_step is not None else 1)
                 else:
-                    self.state, metrics = self.train_step(
-                        self.state, batch if use_pipe else self.put(batch))
-                    last_loss = metrics["loss"]
-                prev = gstep
-                gstep += n
-                examples += n_examples
-                profiler.step(gstep)
-                if heartbeat is not None:
-                    heartbeat.beat()
-                if resume_every and gstep // resume_every != prev // resume_every:
-                    self.save_resume(args.resume_path())
-                if gstep // args.log_every != prev // args.log_every:
-                    if pending is not None:  # print the *previous* line's loss:
-                        e, s, l = pending     # it is done by now — no sync stall
-                        if hooks.on_log is not None:
-                            hooks.on_log(e, s, total_step, float(l))
+                    train_loader.set_epoch(epoch - 1)
+                    groups = self._macro_batches(train_loader, fuse, stage)
+                # data_wait: host time blocked obtaining each group (collation,
+                # the prefetch queue, or the resident gather dispatch)
+                groups = tr.wrap_iter("data_wait", groups)
+                for batch, n, fused, n_examples in groups:
+                    if gstep + n <= start_step:  # already done before the restart
+                        gstep += n
+                        if heartbeat is not None:  # long fast-forwards stay live
+                            heartbeat.beat(step=gstep)
+                        continue
+                    if gstep < start_step:
+                        # the restored step falls inside this fused group:
+                        # executing it would re-apply updates the restored
+                        # optimizer state already contains
+                        raise ValueError(
+                            f"resume step {start_step} is not a fused-group "
+                            f"boundary under fuse_steps={fuse} (group covers "
+                            f"steps {gstep + 1}..{gstep + n}) — resume with the "
+                            "fuse_steps the snapshot was saved under, or 1")
+                    if fault_step and start_step == 0 and gstep >= fault_step \
+                            and jax.process_index() == fault_proc:
+                        os._exit(13)
+                    if fused:
+                        if use_pipe:
+                            dev = batch
                         else:
-                            rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
-                    pending = (epoch, gstep, last_loss)
-                # boundary-crossing, not equality: with fuse_steps=K the
-                # counter advances K at a time, so when K does not divide
-                # eval_step the eval lands up to K-1 steps late (count per
-                # epoch preserved).  Pick eval_step divisible by fuse_steps
-                # (bench.py: 48 under K=4) for exact reference cadence;
-                # AutoTrainer instead rejects non-divisible combinations.
-                if dev_loader is not None and args.dev and \
-                        gstep // args.eval_step != prev // args.eval_step:
-                    if hooks.on_eval is not None:
-                        hooks.on_eval(gstep)
+                            with tr.span("h2d_put", step=gstep + n):
+                                dev = self.put_fused(batch)
+                            if stage is not None:
+                                stage.verify(batch, dev)  # aliasing guard, once
+                        with tr.span("step_dispatch", step=gstep + n, n=n):
+                            self.state, metrics = self.multi_step(self.state, dev)
+                        last_loss = metrics["loss"][-1]
                     else:
-                        self._dev_and_maybe_save(dev_loader)
-                if hooks.save_every and hooks.on_save is not None and \
-                        gstep // hooks.save_every != prev // hooks.save_every:
-                    hooks.on_save(gstep)
-        if pending is not None:
-            e, s, l = pending
-            if hooks.on_log is not None:
-                hooks.on_log(e, s, total_step, float(l))
-            else:
-                rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
-        # True completion barrier: fetch a VALUE from the last enqueued
-        # program.  Device programs execute in order, so the fetch cannot
-        # return before every prior step has run.  block_until_ready alone
-        # is not trustworthy on async-RPC device tunnels (observed on the
-        # 'axon' TPU platform: it returns at enqueue, not completion).
-        if last_loss is not None:
-            float(jax.device_get(last_loss))
-        jax.block_until_ready(self.state["params"])
-        profiler.close()
+                        if use_pipe:
+                            dev = batch
+                        else:
+                            with tr.span("h2d_put", step=gstep + n):
+                                dev = self.put(batch)
+                        with tr.span("step_dispatch", step=gstep + n, n=n):
+                            self.state, metrics = self.train_step(self.state, dev)
+                        last_loss = metrics["loss"]
+                    # traced runs attribute device time to a separate
+                    # device_block span (dispatch above measured enqueue only);
+                    # untraced runs keep the async discipline — block is a
+                    # no-op on a disabled tracer, never a hidden barrier
+                    tr.block(last_loss, step=gstep + n, n=n)
+                    prev = gstep
+                    gstep += n
+                    examples += n_examples
+                    profiler.step(gstep)
+                    if heartbeat is not None:
+                        heartbeat.beat(
+                            step=gstep,
+                            steps_per_sec=detector.steps_per_sec
+                            if detector is not None else None)
+                    if resume_every and gstep // resume_every != prev // resume_every:
+                        with tr.span("ckpt_save", step=gstep):
+                            self.save_resume(args.resume_path())
+                    if gstep // args.log_every != prev // args.log_every:
+                        if pending is not None:  # print the *previous* line's loss:
+                            e, s, l = pending     # it is done by now — no sync stall
+                            with tr.span("log", step=gstep):
+                                if hooks.on_log is not None:
+                                    hooks.on_log(e, s, total_step, float(l))
+                                else:
+                                    rank0_print(fmt_train(
+                                        e, args.epochs, s, total_step, float(l)))
+                        pending = (epoch, gstep, last_loss)
+                    # boundary-crossing, not equality: with fuse_steps=K the
+                    # counter advances K at a time, so when K does not divide
+                    # eval_step the eval lands up to K-1 steps late (count per
+                    # epoch preserved).  Pick eval_step divisible by fuse_steps
+                    # (bench.py: 48 under K=4) for exact reference cadence;
+                    # AutoTrainer instead rejects non-divisible combinations.
+                    if dev_loader is not None and args.dev and \
+                            gstep // args.eval_step != prev // args.eval_step:
+                        with tr.span("eval", step=gstep):
+                            if hooks.on_eval is not None:
+                                hooks.on_eval(gstep)
+                            else:
+                                self._dev_and_maybe_save(dev_loader)
+                    if hooks.save_every and hooks.on_save is not None and \
+                            gstep // hooks.save_every != prev // hooks.save_every:
+                        hooks.on_save(gstep)
+            if pending is not None:
+                e, s, l = pending
+                if hooks.on_log is not None:
+                    hooks.on_log(e, s, total_step, float(l))
+                else:
+                    rank0_print(fmt_train(e, args.epochs, s, total_step, float(l)))
+            # True completion barrier: fetch a VALUE from the last enqueued
+            # program.  Device programs execute in order, so the fetch cannot
+            # return before every prior step has run.  block_until_ready alone
+            # is not trustworthy on async-RPC device tunnels (observed on the
+            # 'axon' TPU platform: it returns at enqueue, not completion).
+            if last_loss is not None:
+                float(jax.device_get(last_loss))
+            jax.block_until_ready(self.state["params"])
+            profiler.close()
+        finally:
+            if breakdown is not None:
+                tr.remove_listener(breakdown.feed)
+        if breakdown is not None:
+            from pdnlp_tpu.obs import format_table
+
+            breakdown.close()
+            self.trace_summary = breakdown.summary()
+            path = tr.flush()
+            rank0_print("[obs] phase breakdown:\n"
+                        + format_table(self.trace_summary)
+                        + (f"\n[obs] spans -> {path}" if path else ""))
         if hooks.on_end is not None:
             hooks.on_end()  # durability work that must count in the runtime
         minutes = (time.time() - start) / 60

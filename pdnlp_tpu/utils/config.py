@@ -145,6 +145,16 @@ class Args:
                                                   # encoded split must fit
                                                   # this many MB of HBM
     log_every: int = 1
+    trace: bool = False                           # obs span tracing (pdnlp_
+                                                  # tpu.obs): per-step phase
+                                                  # spans + breakdown +
+                                                  # regression detector;
+                                                  # off by default, <2%
+                                                  # steps/s when on
+                                                  # (bench.py --trace)
+    trace_dir: Optional[str] = None               # span files (trace_proc
+                                                  # <i>.jsonl); default
+                                                  # <output_dir>/trace
     profile_dir: Optional[str] = None             # jax.profiler trace output
     warmup_compile: bool = False                  # AOT-compile steps before
                                                   # the timed epoch (bench

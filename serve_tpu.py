@@ -147,6 +147,11 @@ def main(argv=None) -> None:
 
         rank0_print(json.dumps(engine.metrics.snapshot(), indent=2),
                     file=sys.stderr)
+    # --trace true: the ring buffer means nothing unless it lands on disk
+    # — the trainer flushes at end-of-train, the serve CLI flushes here
+    trace_path = engine.tracer.flush()
+    if trace_path:
+        rank0_print(f"[obs] spans -> {trace_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,17 @@
+"""R4 negative, tracer idiom: the tracer's own block API is a real
+barrier — Span.block wraps jax.block_until_ready in a device_block span,
+so the manual delta reads after completion."""
+import time
+
+import jax
+
+from pdnlp_tpu.obs import get_tracer
+
+
+def traced_step_blocked(step, state, batch):
+    with get_tracer().span("step_dispatch") as sp:
+        t0 = time.perf_counter()
+        state, m = step(state, batch)
+        sp.block(m["loss"])             # tracer barrier: device_block span
+        dt = time.perf_counter() - t0
+    return state, dt

@@ -1,0 +1,15 @@
+"""R4 positive, tracer idiom: an obs span around a dispatch does NOT make
+a manual timing window honest — the span itself never blocks."""
+import time
+
+import jax
+
+from pdnlp_tpu.obs import get_tracer
+
+
+def traced_step_still_unblocked(step, state, batch):
+    with get_tracer().span("step_dispatch") as sp:
+        t0 = time.perf_counter()
+        state, m = step(state, batch)
+        dt = time.perf_counter() - t0   # line 14: async — measures enqueue
+    return state, dt

@@ -55,42 +55,106 @@ def elastic_run(tmp_path_factory):
     return proc, out
 
 
+class FakeClock:
+    """Injected time source: the stall thresholds are exact comparisons
+    against this, never against real sleeps — deterministic under any CPU
+    contention (the old real-sleep version flaked in tier-1)."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeProc:
+    def __init__(self, code=None):
+        self.code = code
+
+    def poll(self):
+        return self.code
+
+
 def test_gang_monitor_stall_detection(tmp_path):
     """The stall detector (no crash, heartbeats stop) — unit-level, no
-    processes: verdicts depend only on child poll() codes and heartbeat
-    file mtimes."""
-    import time
-
+    processes, no sleeps: both sides run on one injected clock, so the
+    timeout arithmetic is exact."""
     from pdnlp_tpu.parallel.watchdog import GangMonitor, Heartbeat
 
-    class FakeProc:
-        def __init__(self, code=None):
-            self.code = code
-
-        def poll(self):
-            return self.code
-
+    clk = FakeClock()
     procs = [FakeProc(), FakeProc()]
-    mon = GangMonitor(procs, str(tmp_path), 2, stall_timeout=0.3)
+    mon = GangMonitor(procs, str(tmp_path), 2, stall_timeout=30.0,
+                      clock=clk)
     # no rank has ever beaten: grace period, healthy
     assert mon.poll() is None
     # both beat now -> healthy
-    hb0 = Heartbeat(str(tmp_path), 0, interval=0.0)
-    hb1 = Heartbeat(str(tmp_path), 1, interval=0.0)
-    hb0.beat(force=True)
-    hb1.beat(force=True)
+    hb0 = Heartbeat(str(tmp_path), 0, interval=0.0, clock=clk)
+    hb1 = Heartbeat(str(tmp_path), 1, interval=0.0, clock=clk)
+    clk.advance(1.0)
+    hb0.beat(force=True, step=4)
+    hb1.beat(force=True, step=4)
     assert mon.poll() is None
     # rank 1 goes quiet past the timeout while rank 0 keeps beating
-    time.sleep(0.4)
-    hb0.beat(force=True)
+    clk.advance(31.0)
+    hb0.beat(force=True, step=40)
     v = mon.poll()
     assert v is not None and v["kind"] == "stalled", v
+    assert v["stalest_beat_s"] == 31.0
+    # the verdict carries the gang's LAGGARD progress metadata: the monitor
+    # can tell "slow but advancing" from "dead at step 4"
+    assert v["last_step"] == 4
     # a nonzero child exit is classified as a crash (takes precedence)
     procs[1].code = 13
     assert mon.poll()["kind"] == "crashed"
     # all children exiting 0 ends the run
     procs[0].code = procs[1].code = 0
     assert mon.poll()["kind"] == "done"
+
+
+def test_gang_monitor_startup_stall_without_any_beat(tmp_path):
+    """Rendezvous deadlock shape: nobody ever beats — stall after the 4x
+    pre-first-beat grace window (exact, on the injected clock)."""
+    from pdnlp_tpu.parallel.watchdog import GangMonitor
+
+    clk = FakeClock()
+    mon = GangMonitor([FakeProc()], str(tmp_path), 1, stall_timeout=30.0,
+                      clock=clk)
+    clk.advance(4 * 30.0)
+    assert mon.poll() is None  # boundary: strictly-greater fires the stall
+    clk.advance(0.5)
+    v = mon.poll()
+    assert v is not None and v["kind"] == "stalled"
+    assert v["stalest_beat_s"] is None
+
+
+def test_heartbeat_payload_and_monitor_status(tmp_path):
+    """The beat file carries step metadata; the monitor surfaces it in its
+    status line and derives steps/s from consecutive beats when the worker
+    does not supply a smoothed rate."""
+    from pdnlp_tpu.parallel.watchdog import GangMonitor, Heartbeat
+
+    clk = FakeClock()
+    mon = GangMonitor([FakeProc()], str(tmp_path), 1, stall_timeout=30.0,
+                      clock=clk)
+    hb = Heartbeat(str(tmp_path), 0, interval=0.0, clock=clk)
+    clk.advance(1.0)
+    hb.beat(force=True, step=10)
+    clk.advance(5.0)
+    hb.beat(force=True, step=20)  # 10 steps / 5 s -> derived rate 2.0
+    s = mon.status()
+    assert s["last_step"] == 20
+    assert s["steps_per_sec"] == 2.0
+    assert s["stalest_beat_s"] == 0.0
+    line = mon.status_line()
+    assert "step 20" in line and "2.0 steps/s" in line
+    # an explicitly supplied smoothed rate (the obs regression detector's)
+    # wins over the derived one
+    clk.advance(1.0)
+    hb.beat(force=True, step=22, steps_per_sec=3.5)
+    assert mon.status()["steps_per_sec"] == 3.5
 
 
 def test_elastic_restart_completes(elastic_run):

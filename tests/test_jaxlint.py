@@ -73,6 +73,24 @@ def test_r4_unblocked_timing_negative():
     assert hits("r4_neg.py", "R4") == []
 
 
+def test_r4_tracer_span_does_not_exempt_timing():
+    # an obs span around the dispatch is observability, not a barrier —
+    # a manual delta inside it must still be flagged
+    assert all_hits("r4_tracer_pos.py") == [("R4", 14)]
+
+
+def test_r4_tracer_block_is_the_exempt_barrier():
+    # Span.block wraps jax.block_until_ready — the sanctioned fix
+    assert hits("r4_tracer_neg.py", "R4") == []
+
+
+def test_r4_hint_names_the_tracer_block_api():
+    path = os.path.join(FIXTURES, "r4_tracer_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R4"][0]
+    assert "block" in f.hint and "pdnlp_tpu.obs" in f.hint
+
+
 def test_r5_missing_donate_positive():
     assert all_hits("r5_pos.py") == [
         ("R5", 11), ("R5", 17), ("R5", 20), ("R5", 25)]

@@ -120,6 +120,34 @@ def test_checkpoint_roundtrip(cfg, args, tmp_path):
         np.asarray(jax.tree_util.tree_leaves(state["params"])[0]))
 
 
+def test_latest_orders_step_family_by_step_not_mtime(tmp_path):
+    """One step family (same stem, trailing -<n>): the step number orders
+    the candidates even when a cp -p restore or a coarse-mtime filesystem
+    scrambles/ties the timestamps."""
+    for step, mtime in (("100", 3000), ("1500", 1000), ("200", 2000)):
+        p = tmp_path / f"ckpt-{step}.msgpack"
+        p.write_bytes(b"x")
+        os.utime(p, (mtime, mtime))  # newest mtime is NOT the newest step
+    got = checkpoint.latest(str(tmp_path))
+    assert os.path.basename(got) == "ckpt-1500.msgpack"
+
+
+def test_latest_mixed_names_fall_back_to_mtime(tmp_path):
+    """Interior/attached digits are not steps: pretrained-e5 (epoch tag)
+    must never outrank a newer zero2-cls on its digit."""
+    old = tmp_path / "pretrained-e5.msgpack"
+    new = tmp_path / "zero2-cls.msgpack"
+    old.write_bytes(b"x")
+    new.write_bytes(b"x")
+    os.utime(old, (1000, 1000))
+    os.utime(new, (2000, 2000))
+    got = checkpoint.latest(str(tmp_path))
+    assert os.path.basename(got) == "zero2-cls.msgpack"
+    # deterministic tie-break on equal mtimes (coarse-mtime tie)
+    os.utime(old, (2000, 2000))
+    assert checkpoint.latest(str(tmp_path)) is not None
+
+
 class _ListLoader:
     """Minimal loader: fixed list of batches, sampler-compatible."""
 

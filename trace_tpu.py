@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""trace_tpu.py — inspect, diff, and convert ``pdnlp_tpu.obs`` traces.
+
+Subcommands:
+
+- ``summarize <trace>`` — the per-phase table (count / total / mean / p50
+  / p95 / share) of one trace file;
+- ``diff <base> <candidate>`` — per-phase mean deltas between two traces;
+  exits **1** when any phase's mean grew beyond ``--threshold`` (default
+  0.20 = 20%) — the CI guard: run a traced smoke on main and on a PR, diff
+  the two files, and a phase regression fails the job with the phase named;
+- ``export <trace> -o out.json`` — convert a compact JSONL span log to
+  Chrome-trace JSON (load it at https://ui.perfetto.dev or
+  ``chrome://tracing``).
+
+Accepted inputs everywhere: the per-process ``trace_proc<i>.jsonl`` files
+``Tracer.flush`` writes, or an already-exported Chrome-trace ``.json``.
+Pure stdlib — runs on hosts without jax installed.
+
+    python trace_tpu.py summarize output/trace/trace_proc0.jsonl
+    python trace_tpu.py diff main.jsonl pr.jsonl --threshold 0.2
+    python trace_tpu.py export output/trace/trace_proc0.jsonl -o t.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from pdnlp_tpu.obs.export import load_records, write_chrome_trace
+from pdnlp_tpu.obs.phases import StepBreakdown, format_table
+from pdnlp_tpu.obs.regress import diff_breakdowns
+
+
+def _summary(path: str):
+    return StepBreakdown.from_records(load_records(path)).summary()
+
+
+def cmd_summarize(ns) -> int:
+    summary = _summary(ns.trace)
+    if ns.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(format_table(summary))
+    return 0
+
+
+def cmd_diff(ns) -> int:
+    base, cand = _summary(ns.base), _summary(ns.candidate)
+    diff = diff_breakdowns(base, cand, threshold=ns.threshold,
+                           min_mean_sec=ns.min_mean_sec,
+                           min_count=ns.min_count)
+    if ns.json:
+        print(json.dumps(diff, indent=2))
+    else:
+        header = (f"{'phase':<14} {'base_ms':>10} {'cand_ms':>10} "
+                  f"{'delta':>8}")
+        print(header)
+        print("-" * len(header))
+        for name, row in diff["phases"].items():
+            am, bm, d = (row["base_mean_sec"], row["cand_mean_sec"],
+                         row["delta_ratio"])
+            mark = "  << REGRESSED" if row["regressed"] else ""
+            print(f"{name:<14} "
+                  f"{am * 1e3 if am else float('nan'):>10.3f} "
+                  f"{bm * 1e3 if bm else float('nan'):>10.3f} "
+                  f"{f'{d:+.1%}' if d is not None else 'n/a':>8}{mark}")
+    if diff["regressions"]:
+        print(f"REGRESSION: phase(s) {', '.join(diff['regressions'])} mean "
+              f"grew >= {ns.threshold:.0%} vs {ns.base}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_export(ns) -> int:
+    out = ns.output or (ns.trace.rsplit(".", 1)[0] + ".chrome.json")
+    write_chrome_trace(load_records(ns.trace), out)
+    print(f"wrote {out} — load it at https://ui.perfetto.dev "
+          "or chrome://tracing")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="trace_tpu.py",
+        description="summarize / diff / export pdnlp_tpu.obs traces")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("summarize", help="per-phase table of one trace")
+    s.add_argument("trace")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_summarize)
+
+    d = sub.add_parser("diff", help="per-phase delta; exit 1 on regression")
+    d.add_argument("base")
+    d.add_argument("candidate")
+    d.add_argument("--threshold", type=float, default=0.2,
+                   help="flag a phase whose mean grew >= this fraction "
+                        "(default 0.2)")
+    d.add_argument("--min_mean_sec", type=float, default=1e-6,
+                   help="phases under this base mean are never flagged "
+                        "(noise floor)")
+    d.add_argument("--min_count", type=int, default=5,
+                   help="phases with fewer observations than this in "
+                        "either trace are never flagged (1-2 samples of "
+                        "an amortized upload are noise, not a trend)")
+    d.add_argument("--json", action="store_true")
+    d.set_defaults(fn=cmd_diff)
+
+    e = sub.add_parser("export", help="JSONL span log -> Chrome-trace JSON")
+    e.add_argument("trace")
+    e.add_argument("-o", "--output", default=None)
+    e.set_defaults(fn=cmd_export)
+    return p
+
+
+def main(argv=None) -> int:
+    ns = build_parser().parse_args(argv)
+    return ns.fn(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
