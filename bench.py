@@ -194,12 +194,29 @@ def serve_smoke(argv) -> None:
                  f"(expected 0) — see {out_path}")
 
 
+def _smoke_model(args, vocab_size):
+    """Mesh + sharded DP model + jitted step + put — the ONE model/mesh
+    configuration every bench smoke measures against (``--pipeline``,
+    ``--trace``, and ``--length`` all build on it, so they cannot drift in
+    what they time).  Returns ``(mesh, cfg, tx, state0, sh, step, put)``."""
+    from pdnlp_tpu.parallel import (
+        make_global_batch, make_mesh, make_parallel_train_step,
+        setup_sharded_model,
+    )
+
+    mesh = make_mesh(num_devices=args.num_devices, shape=args.mesh_shape)
+    cfg, tx, state0, sh = setup_sharded_model(args, vocab_size, mesh, "dp")
+    step = make_parallel_train_step(cfg, tx, args, mesh, sh)
+    put = make_global_batch(mesh)
+    return mesh, cfg, tx, state0, sh, step, put
+
+
 def _smoke_train_setup(args):
     """Shared scaffold for the ``--pipeline`` and ``--trace`` smokes: the
     seeded corpus (real when present, synthetic otherwise), a
     fresh-DataLoader factory, and ONE jitted DP train step on the bench
-    mesh — one copy, so the two smokes cannot drift in what they measure.
-    Returns ``(fresh_loader, mesh, state0, step, put)``."""
+    mesh (``_smoke_model``) — one copy, so the two smokes cannot drift in
+    what they measure.  Returns ``(fresh_loader, mesh, state0, step, put)``."""
     import random
 
     from pdnlp_tpu.data import (
@@ -207,10 +224,6 @@ def _smoke_train_setup(args):
     )
     from pdnlp_tpu.data.collate import EncodedDataset
     from pdnlp_tpu.data.sampler import DistributedShardSampler
-    from pdnlp_tpu.parallel import (
-        make_global_batch, make_mesh, make_parallel_train_step,
-        setup_sharded_model,
-    )
 
     if os.path.exists(args.data_path):
         from pdnlp_tpu.data import load_data
@@ -236,12 +249,245 @@ def _smoke_train_setup(args):
             encoded=EncodedDataset(corpus, tok, args.max_seq_len)
             if encoded else None)
 
-    mesh = make_mesh(num_devices=args.num_devices, shape=args.mesh_shape)
-    cfg, tx, state0, sh = setup_sharded_model(args, tok.vocab_size, mesh,
-                                              "dp")
-    step = make_parallel_train_step(cfg, tx, args, mesh, sh)
-    put = make_global_batch(mesh)
+    mesh, _cfg, _tx, state0, _sh, step, put = _smoke_model(
+        args, tok.vocab_size)
     return fresh_loader, mesh, state0, step, put
+
+
+def length_smoke(argv, modes_arg: str) -> None:
+    """``--length {full,bucket,pack,all}``: length-aware training A/B.
+
+    Short seeded training runs (bert-tiny, mesh DP, ``fuse_steps`` intact)
+    per ``--length_mode``, all over ONE jitted step/multi-step pair, each
+    driven through its own input pipeline (``auto`` — resident when
+    eligible, exercising the per-bucket gathers).  The corpus is synthetic
+    and CPU-safe with the REAL corpus's length shape (~18-token average,
+    long tail) and a first-character-determined label, so every mode can
+    actually learn it and the dev-accuracy parity gate compares converged
+    numbers, not noise.  Reports per mode: samples/s and the speedup over
+    ``full``, steps/epoch, compile counts (step + multi-step + resident
+    gathers), the per-bucket batch histogram, token- and row-level padding
+    waste, and dev accuracy on one SHARED full-width dev set (eval
+    semantics never change with the training layout).  Exits non-zero on
+    a retrace after warmup (any compile-cache growth during the timed
+    epochs) or a dev-accuracy parity violation (``--length_tolerance``,
+    default 0.08 absolute vs ``full``).  Writes ``results/
+    length_smoke.json`` (override: ``--length_out``).
+    """
+    import random
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pdnlp_tpu.data import Collator, DataLoader, WordPieceTokenizer, build_vocab
+    from pdnlp_tpu.data.collate import EncodedDataset
+    from pdnlp_tpu.data.packing import PackedClassificationDataset
+    from pdnlp_tpu.data.pipeline import build_pipeline
+    from pdnlp_tpu.data.sampler import DistributedShardSampler
+    from pdnlp_tpu.parallel import make_global_batch, make_parallel_eval_step
+    from pdnlp_tpu.parallel.execution import make_parallel_multi_step
+    from pdnlp_tpu.train.setup import build_length_train_loader
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, out_path = pop_cli_flag(
+        argv, "--length_out", os.path.join("results", "length_smoke.json"))
+    argv, epochs = pop_cli_flag(argv, "--length_epochs", 6, int)
+    argv, tolerance = pop_cli_flag(argv, "--length_tolerance", 0.08, float)
+    # the smoke's bucket set adds a 16 floor under the stock 32/64/128:
+    # this corpus (like the real one) averages ~18 tokens, so a 32-token
+    # floor alone would pad the typical example ~45% — bucket choice is
+    # part of the optimization, matched to the length profile
+    args = parse_cli(argv, base=Args(
+        model="bert-tiny", max_seq_len=128, train_batch_size=16,
+        learning_rate=1e-3, dropout=0.0, attn_dropout=0.0, fuse_steps=4,
+        length_buckets="16,32,64,128", log_every=10 ** 9))
+    all_modes = ("full", "bucket", "pack")
+    modes = all_modes if modes_arg == "all" else tuple(modes_arg.split(","))
+    for m in modes:
+        if m not in all_modes:
+            sys.exit(f"--length {m!r}: pick from {'|'.join(all_modes)}|all")
+
+    # synthetic corpus with the real corpus's length profile: one token per
+    # CJK char, ~18-token average with a 30-126 tail; the label is a pure
+    # function of the first character, so a converged dev accuracy is a
+    # property of the MODE's training math, not of label noise
+    chars = "天地人你我他好坏大小上下来去爱恨喜怒哀乐高兴悲伤讨厌愤怒"
+    rng = random.Random(args.seed)
+
+    def synth(n):
+        out = []
+        for _ in range(n):
+            r = rng.random()
+            length = (rng.randint(4, 24) if r < 0.78 else
+                      rng.randint(25, 60) if r < 0.92 else
+                      rng.randint(61, 126))
+            text = "".join(rng.choice(chars) for _ in range(length))
+            out.append((text, chars.index(text[0]) % args.num_labels))
+        return out
+
+    train_data, dev_data = synth(1024), synth(256)
+    tok = WordPieceTokenizer(build_vocab((t for t, _ in train_data), size=256))
+    col = Collator(tok, args.max_seq_len)
+    enc = EncodedDataset(train_data, tok, args.max_seq_len)
+    dev_enc = EncodedDataset(dev_data, tok, args.max_seq_len)
+    dev_loader = DataLoader(
+        dev_data, col, args.train_batch_size,
+        sampler=DistributedShardSampler(len(dev_data), shuffle=False),
+        encoded=dev_enc)
+
+    mesh, cfg, tx, state0, sh, step, put = _smoke_model(args, tok.vocab_size)
+    multi = make_parallel_multi_step(cfg, tx, args, mesh, sh)
+    eval_step = make_parallel_eval_step(cfg, args, mesh, sh["params"])
+    put_fused = make_global_batch(mesh, leading_stack=True)
+
+    def cache_sizes(pipe):
+        """(step, multi, gathers) compiled-variant counts — the bounded
+        ``len(buckets) x len(step-variants)`` claim, measured."""
+        gathers = sum(
+            getattr(g, "_cache_size", lambda: 0)()
+            for g in getattr(pipe, "_gathers", {}).values())
+        return (step._cache_size(), multi._cache_size(), gathers)
+
+    def run_epochs(pipe, state, n_epochs, first_epoch=0):
+        """Dispatch ``n_epochs`` epochs; returns (state, examples, last).
+        The caller fetches a VALUE from ``last`` before reading a clock —
+        async dispatch would otherwise time enqueue, not compute."""
+        examples, last = 0, None
+        for e in range(first_epoch, first_epoch + n_epochs):
+            pipe.set_epoch(e)
+            for batch, n, fused, ex in pipe.macro_batches(args.fuse_steps):
+                if fused:
+                    state, m = multi(state, batch)
+                    last = m["loss"][-1]
+                else:
+                    state, m = step(state, batch)
+                    last = m["loss"]
+                examples += ex
+        return state, examples, last
+
+    # compile the shared full-width eval program once up front: every mode
+    # evaluates through the identical program, and the dev evals below all
+    # run OUTSIDE the timed window
+    ev = eval_step(state0["params"], put(next(iter(dev_loader))))
+    float(jax.device_get(ev["correct"]))
+
+    rows, acc_by_mode = [], {}
+    for mode in modes:
+        margs = args.replace(length_mode=mode)
+        loader = build_length_train_loader(
+            margs, train_data, col, enc,
+            batch_size=args.train_batch_size)
+        pipe = build_pipeline(margs, loader, put=put, put_fused=put_fused,
+                              mesh=mesh)
+        packed_stats = (loader.encoded.stats()
+                        if isinstance(loader.encoded,
+                                      PackedClassificationDataset) else None)
+        # warmup: one full untimed epoch on a throwaway state copy visits
+        # every (bucket x step-variant) shape this mode can produce.
+        # step/multi jit caches are SHARED across the mode loop (that is
+        # the point — one program pair), so per-mode compile counts are
+        # deltas against the pre-warmup sizes, not absolute cache sizes
+        pre = cache_sizes(pipe)
+        wstate, _, wlast = run_epochs(
+            pipe, jax.tree_util.tree_map(jnp.copy, state0), 1)
+        float(jax.device_get(wlast))
+        del wstate
+        compiled = cache_sizes(pipe)
+        pipe.stats.__init__()  # steady-state telemetry only
+        pipe.stats.mode = pipe.mode
+
+        state = jax.tree_util.tree_map(jnp.copy, state0)
+        t0 = time.monotonic()
+        state, examples, last = run_epochs(pipe, state, epochs,
+                                           first_epoch=1)
+        float(jax.device_get(last))  # completion barrier inside the timer
+        elapsed = time.monotonic() - t0
+        compiled_after = cache_sizes(pipe)
+        retraces = sum(compiled_after) - sum(compiled)
+
+        # dev accuracy, SHARED full-width eval path for every mode
+        correct = weight = 0.0
+        # untimed dev eval over a host loader: dispatch-all-then-gather is
+        # already the async pattern, and the upload cost sits outside the
+        # samples/s measurement window
+        # jaxlint: disable=R7 — eval transport outside the timed window
+        pending = [eval_step(state["params"], put(b)) for b in dev_loader]
+        for m in jax.device_get(pending):
+            correct += float(m["correct"])
+            weight += float(m["weight"])
+        acc = correct / max(weight, 1.0)
+        acc_by_mode[mode] = acc
+        del state
+
+        snap = pipe.stats.snapshot()
+        rows.append({
+            "mode": mode,
+            "pipeline": pipe.mode,
+            "steps_per_epoch": len(loader),
+            "epochs": epochs,
+            "examples": examples,
+            "samples_per_sec": round(examples / elapsed, 2),
+            "steps_per_sec": round(snap["steps"] / elapsed, 2),
+            "dev_accuracy": round(acc, 4),
+            "compiled_variants": {
+                "train_step": compiled[0] - pre[0],
+                "multi_step": compiled[1] - pre[1],
+                "resident_gathers": compiled[2] - pre[2]},
+            "retraces_post_warmup": retraces,
+            "padding_waste_tokens": snap["padding_waste_tokens"],
+            "padding_waste_rows": snap["padding_waste_ratio"],
+            "batches_by_bucket": {
+                seq: b["steps"] for seq, b in
+                snap.get("by_bucket", {}).items()},
+            "by_bucket": snap.get("by_bucket"),
+            "packing": packed_stats,
+        })
+
+    by_mode = {r["mode"]: r for r in rows}
+    base_rate = by_mode.get("full", {}).get("samples_per_sec")
+    for r in rows:
+        r["speedup_vs_full"] = (round(r["samples_per_sec"] / base_rate, 3)
+                                if base_rate and r["mode"] != "full"
+                                else None)
+    result = {
+        "metric": "length_smoke",
+        "model": args.model,
+        "batch_size": args.train_batch_size,
+        "seq_len": args.max_seq_len,
+        "buckets": args.length_buckets,
+        "fuse_steps": args.fuse_steps,
+        "train_examples": len(train_data),
+        "dev_examples": len(dev_data),
+        "devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+        "dtype": args.dtype,
+        "accuracy_tolerance": tolerance,
+        "modes": rows,
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, out_path)
+    print(json.dumps({**result,
+                      "modes": [{k: v for k, v in r.items()
+                                 if k != "by_bucket"} for r in rows]}))
+    bad_retrace = [r["mode"] for r in rows if r["retraces_post_warmup"]]
+    if bad_retrace:
+        sys.exit("length smoke FAILED: post-warmup retrace in "
+                 f"{bad_retrace} — the compile count is not bounded by "
+                 f"buckets x step-variants; see {out_path}")
+    if "full" in acc_by_mode:
+        drift = {m: round(a - acc_by_mode["full"], 4)
+                 for m, a in acc_by_mode.items() if m != "full"}
+        worst = [m for m, d in drift.items() if d < -tolerance]
+        if worst:
+            sys.exit("length smoke FAILED: dev-accuracy parity violated "
+                     f"for {worst} (drift {drift}, tolerance {tolerance}) "
+                     f"— see {out_path}")
 
 
 def pipeline_smoke(argv, modes_arg: str) -> None:
@@ -530,6 +776,14 @@ def main() -> None:
 
         argv, modes_arg = pop_cli_flag(argv, "--pipeline", "all")
         return pipeline_smoke(argv, modes_arg)
+    if "--length" in argv:
+        # like --pipeline: a bench smoke intercept, not Args.length_mode (a
+        # length-aware HEADLINE run is `--length_mode bucket|pack` on the
+        # ordinary entrypoints; the bench's own flag is the A/B smoke)
+        from pdnlp_tpu.utils.config import pop_cli_flag
+
+        argv, modes_arg = pop_cli_flag(argv, "--length", "all")
+        return length_smoke(argv, modes_arg)
     if "--serve" in argv:
         # No pretrain-cache key to fold a leaked PDNLP_GELU_TANH into here:
         # serving would silently run tanh forwards over an erf-trained

@@ -1,7 +1,13 @@
 from pdnlp_tpu.data.corpus import LABELS, label2id, id2label, load_data, split_data
 from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
 from pdnlp_tpu.data.collate import Collator, EncodedDataset
-from pdnlp_tpu.data.sampler import DistributedShardSampler
+from pdnlp_tpu.data.packing import (
+    PackedClassificationDataset, pack_classification,
+)
+from pdnlp_tpu.data.sampler import (
+    DistributedShardSampler, LengthGroupedSampler, parse_buckets,
+    resolve_length_mode,
+)
 from pdnlp_tpu.data.loader import DataLoader
 from pdnlp_tpu.data.pipeline import (
     DevicePrefetchPipeline, DeviceResidentPipeline, InputPipeline,

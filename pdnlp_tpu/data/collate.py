@@ -25,11 +25,16 @@ class Collator:
         self.tokenizer = tokenizer
         self.max_seq_len = max_seq_len
 
-    def __call__(self, examples: Sequence[Tuple[str, int]], pad_to: int = 0) -> Batch:
-        """Encode a list of examples; pad the batch up to ``pad_to`` rows."""
+    def __call__(self, examples: Sequence[Tuple[str, int]], pad_to: int = 0,
+                 seq_len: int = 0) -> Batch:
+        """Encode a list of examples; pad the batch up to ``pad_to`` rows.
+
+        ``seq_len`` pads token columns to that width instead of
+        ``max_seq_len`` — the bucket-mode path (``--length_mode bucket``)
+        where the batch's longest example picked the bucket."""
         texts = [t for t, _ in examples]
         labels = [l for _, l in examples]
-        enc = self.tokenizer.encode_batch(texts, self.max_seq_len)
+        enc = self.tokenizer.encode_batch(texts, seq_len or self.max_seq_len)
         n = len(examples)
         rows = max(pad_to, n)
         batch: Batch = {
@@ -100,17 +105,40 @@ class EncodedDataset:
         self.arrays = dict(enc)
         self.arrays["label"] = np.asarray([l for _, l in data], np.int32)
         self.n = len(texts)
+        self.seq_len = max_seq_len
 
     def __len__(self) -> int:
         return self.n
 
-    def take(self, indices: Sequence[int], pad_to: int = 0) -> Batch:
-        """Assemble a batch by row indices; pad with zero-weight filler."""
+    def lengths(self) -> np.ndarray:
+        """Real token count per example (incl. [CLS]/[SEP]) — what the
+        length-grouped sampler buckets on."""
+        return self.arrays["attention_mask"].sum(axis=1).astype(np.int64)
+
+    def take(self, indices: Sequence[int], pad_to: int = 0,
+             seq_len: int = 0) -> Batch:
+        """Assemble a batch by row indices; pad with zero-weight filler.
+
+        ``seq_len`` narrows token columns to that bucket width: the split
+        was encoded once at ``max_seq_len``, and an example whose true
+        length fits the bucket carries only [PAD] (zeros) beyond it, so
+        the column slice is bitwise the direct encoding at ``seq_len``.
+        Only full-width ``[N, max_seq_len]`` channels are sliced —
+        per-segment channels (packed rows' ``cls_positions``/``label``)
+        keep their own width.
+        """
         idx = np.asarray(indices, np.int64)
         n = len(idx)
         rows = max(pad_to, n)
-        batch: Batch = {k: _pad_rows(v[idx], rows) for k, v in self.arrays.items()}
-        w = np.zeros((rows,), np.float32)
-        w[:n] = 1.0
-        batch["example_weight"] = w
+        batch: Batch = {}
+        for k, v in self.arrays.items():
+            g = v[idx]
+            if seq_len and v.ndim == 2 and v.shape[1] == self.seq_len \
+                    and seq_len < self.seq_len:
+                g = g[:, :seq_len]
+            batch[k] = _pad_rows(g, rows)
+        if "example_weight" not in batch:  # packed rows carry their own
+            w = np.zeros((rows,), np.float32)
+            w[:n] = 1.0
+            batch["example_weight"] = w
         return batch

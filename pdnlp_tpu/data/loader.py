@@ -42,31 +42,64 @@ class DataLoader:
         self.drop_last = drop_last
         self.prefetch = prefetch
         self.encoded = encoded
+        if (hasattr(self.sampler, "chunks") and self.drop_last
+                and not getattr(self.sampler, "drop_last", False)):
+            # the sampler chunks GLOBAL batches; a shard-local length test
+            # here would drop different steps on different processes (a
+            # 15-row global tail = 8 rows on shard 0, 7 on shard 1) and
+            # hang the SPMD collectives — short-tail dropping must be the
+            # sampler's, where it is global
+            raise ValueError(
+                "drop_last with a batching sampler must be set on the "
+                "sampler (it owns the global chunking), not the loader")
 
     def __len__(self) -> int:
+        # a batching sampler (LengthGroupedSampler) owns the chunking and
+        # its batch count is epoch-invariant; the flat-stream samplers
+        # keep the classic division
+        n_batches = getattr(self.sampler, "batches_per_epoch", None)
+        if n_batches is not None:
+            return n_batches
         n = len(self.sampler)
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
 
-    def _chunks(self) -> Iterator[List[int]]:
+    def _chunks(self) -> Iterator[Tuple[List[int], int]]:
+        """Yield ``(indices, seq_len)`` per batch; ``seq_len`` 0 = the
+        collator's full ``max_seq_len`` (the classic path).  A sampler
+        with its own ``chunks()`` (length-grouped batching) supplies both
+        the chunking and the bucket width.  The drop_last/batching-sampler
+        conflict is refused at construction (``__init__``)."""
+        if hasattr(self.sampler, "chunks"):
+            yield from self.sampler.chunks()
+            return
         idx = list(self.sampler)
         for i in range(0, len(idx), self.batch_size):
             chunk = idx[i : i + self.batch_size]
             if self.drop_last and len(chunk) < self.batch_size:
                 return
-            yield chunk
+            yield chunk, 0
 
-    def _make(self, chunk: List[int]) -> Batch:
+    def _make(self, chunk: List[int], seq_len: int = 0) -> Batch:
+        # seq_len is only forwarded when a bucketing sampler supplied one:
+        # custom collators/encodings predating the kwarg stay compatible
         if self.encoded is not None:
+            if seq_len:
+                return self.encoded.take(chunk, pad_to=self.batch_size,
+                                         seq_len=seq_len)
             return self.encoded.take(chunk, pad_to=self.batch_size)
-        return self.collator([self.data[j] for j in chunk], pad_to=self.batch_size)
+        examples = [self.data[j] for j in chunk]
+        if seq_len:
+            return self.collator(examples, pad_to=self.batch_size,
+                                 seq_len=seq_len)
+        return self.collator(examples, pad_to=self.batch_size)
 
     def __iter__(self) -> Iterator[Batch]:
         if self.prefetch <= 0:
-            for chunk in self._chunks():
-                yield self._make(chunk)
+            for chunk, seq_len in self._chunks():
+                yield self._make(chunk, seq_len)
             return
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         _SENTINEL = object()
@@ -87,8 +120,8 @@ class DataLoader:
 
         def worker():
             try:
-                for chunk in self._chunks():
-                    if not put_or_stop(self._make(chunk)):
+                for chunk, seq_len in self._chunks():
+                    if not put_or_stop(self._make(chunk, seq_len)):
                         return
                 put_or_stop(_SENTINEL)
             except BaseException as e:  # propagate to the consumer, not /dev/null

@@ -14,10 +14,12 @@ Shapes stay fully static: one (num_rows, S) int32 array per channel.
 """
 from __future__ import annotations
 
+import bisect
 from typing import Dict, List, Sequence
 
 import numpy as np
 
+from pdnlp_tpu.data.collate import EncodedDataset
 from pdnlp_tpu.data.tokenizer import WordPieceTokenizer
 
 
@@ -50,6 +52,118 @@ def pack_texts(
         input_ids[i, : len(r)] = r
         segment_ids[i, : len(s)] = s
     return {"input_ids": input_ids, "segment_ids": segment_ids}
+
+
+class PackedClassificationDataset(EncodedDataset):
+    """Classification examples packed many-per-row — the fine-tune twin of
+    :func:`pack_texts` (``--length_mode pack``).
+
+    Quacks like :class:`~pdnlp_tpu.data.collate.EncodedDataset` (``arrays``
+    / ``take`` / ``lengths``), so the loader, the device-resident pipeline,
+    and the HBM-budget check all work unchanged — the unit simply becomes a
+    packed ROW instead of an example.  Channels per row (all static):
+
+    - ``input_ids`` ``[N, S]``: ``[CLS] text [SEP]`` segments back-to-back;
+    - ``segment_ids`` ``[N, S]``: 1-based per segment, 0 = padding — feeds
+      the block-diagonal ``segment_bias`` so examples never cross-attend;
+    - ``attention_mask`` ``[N, S]``: ``segment_ids > 0``;
+    - ``cls_positions`` ``[N, M]``: each segment's [CLS] token offset (the
+      per-segment pooled-output gather in ``models.bert``);
+    - ``label`` / ``example_weight`` ``[N, M]``: per-SEGMENT targets and
+      weights (0 = empty slot), so the loss stays per-example, not per-row.
+
+    Packing is computed ONCE (best-fit-decreasing, seeded by nothing —
+    deterministic in the data): epochs shuffle packed *rows*, keeping the
+    per-epoch step count and resume arithmetic exact.  What changes vs the
+    host loader is batch composition only — which examples co-occur — never
+    any example's own tokens, mask, or loss weight.
+    """
+
+    def __init__(self, encoded: EncodedDataset, max_segments: int = 16):
+        S = encoded.seq_len
+        M = int(max_segments)
+        if M < 1:
+            raise ValueError(f"pack_max_segments must be >= 1, got {M}")
+        lengths = encoded.lengths()
+        n = len(encoded)
+        # best-fit-decreasing: for each example (longest first) pick the
+        # open row with the LEAST free space that still fits it — O(n log n)
+        # via a bisect-sorted (free, row) list; a row at the segment cap
+        # closes.  Deterministic: ties break on row id (stable tuple order).
+        order = np.argsort(-lengths, kind="stable")
+        rows: List[List[int]] = []
+        open_rows: List[tuple] = []  # sorted (free_tokens, row_id)
+        for i in order.tolist():
+            L = int(lengths[i])
+            j = bisect.bisect_left(open_rows, (L, -1))
+            if j < len(open_rows):
+                free, rid = open_rows.pop(j)
+                rows[rid].append(i)
+                if len(rows[rid]) < M and free - L > 0:
+                    bisect.insort(open_rows, (free - L, rid))
+            else:
+                rows.append([i])
+                if M > 1 and S - L > 0:
+                    bisect.insort(open_rows, (S - L, len(rows) - 1))
+        N = len(rows)
+        src_ids = encoded.arrays["input_ids"]
+        src_lab = encoded.arrays["label"]
+        input_ids = np.zeros((N, S), np.int32)
+        segment_ids = np.zeros((N, S), np.int32)
+        position_ids = np.zeros((N, S), np.int32)
+        cls_pos = np.zeros((N, M), np.int32)
+        label = np.zeros((N, M), np.int32)
+        weight = np.zeros((N, M), np.float32)
+        for r, members in enumerate(rows):
+            off = 0
+            for s, i in enumerate(members):
+                L = int(lengths[i])
+                input_ids[r, off: off + L] = src_ids[i, :L]
+                segment_ids[r, off: off + L] = s + 1
+                # positions restart per segment: each example sees exactly
+                # the position embeddings its unpacked encoding would —
+                # packed-vs-unpacked forward parity is exact, not modulo a
+                # row-offset shift (tests/test_length.py pins it)
+                position_ids[r, off: off + L] = np.arange(L, dtype=np.int32)
+                cls_pos[r, s] = off
+                label[r, s] = src_lab[i]
+                weight[r, s] = 1.0
+                off += L
+        self.arrays = {
+            "input_ids": input_ids,
+            "segment_ids": segment_ids,
+            "position_ids": position_ids,
+            "attention_mask": (segment_ids > 0).astype(np.int32),
+            "token_type_ids": np.zeros((N, S), np.int32),
+            "cls_positions": cls_pos,
+            "label": label,
+            "example_weight": weight,
+        }
+        self.n = N
+        self.seq_len = S
+        self.max_segments = M
+        self.num_examples = n
+
+    def stats(self) -> Dict[str, float]:
+        """Packing efficiency numbers for the bench smoke."""
+        seg_counts = (self.arrays["example_weight"] > 0).sum(1)
+        tokens_real = int(self.arrays["attention_mask"].sum())
+        return {
+            "rows": self.n,
+            "examples": self.num_examples,
+            "tokens_real": tokens_real,
+            "fill_ratio": tokens_real / float(self.n * self.seq_len)
+            if self.n else 0.0,
+            "segments_per_row_mean": float(seg_counts.mean())
+            if self.n else 0.0,
+            "segments_per_row_max": int(seg_counts.max()) if self.n else 0,
+        }
+
+
+def pack_classification(encoded: EncodedDataset, max_segments: int = 16
+                        ) -> PackedClassificationDataset:
+    """Pack an encoded classification split into multi-example rows."""
+    return PackedClassificationDataset(encoded, max_segments=max_segments)
 
 
 def segment_bias(segment_ids: np.ndarray, dtype=np.float32) -> np.ndarray:

@@ -51,6 +51,17 @@ def _nbytes(batch) -> int:
     return sum(getattr(v, "nbytes", 0) for v in batch.values())
 
 
+def _seq_of(batch) -> int:
+    """Token width of a (host or device) batch — the bucket key."""
+    return int(batch["input_ids"].shape[-1])
+
+
+def _tokens_real(host: Batch) -> int:
+    """Non-[PAD] token positions in a HOST batch (numpy sum — never called
+    on device arrays; the resident pipeline counts from host lengths)."""
+    return int(host["attention_mask"].sum())
+
+
 class _MacroStage:
     """Preallocated staging buffers for the K-stacked macro-batch.
 
@@ -73,26 +84,36 @@ class _MacroStage:
         self.k = int(k)
         self.enabled = True
         self.verified = False
-        self._bufs = None
-        self._i = 0
+        # buffers keyed by the group's shape signature: bucket mode feeds
+        # several static shapes through one stage (one ping-pong pair per
+        # bucket — still a bounded, len(buckets)-sized set)
+        self._bufs: dict = {}
+        self._i: dict = {}
+
+    @staticmethod
+    def _sig(batch: Batch) -> tuple:
+        return tuple(sorted((key, v.shape, str(v.dtype))
+                            for key, v in batch.items()))
 
     def stack(self, group) -> Batch:
         """One ``[K, ...]`` host macro-batch from ``k`` host batches."""
         if not self.enabled or self.k <= 1:
             return {key: np.stack([b[key] for b in group])
                     for key in group[0]}
-        if self._bufs is None:
+        sig = self._sig(group[0])
+        if sig not in self._bufs:
             def alloc():
                 return {key: np.empty((self.k,) + v.shape, v.dtype)
                         for key, v in group[0].items()}
-            self._bufs = (alloc(), alloc())
+            self._bufs[sig] = (alloc(), alloc())
+            self._i[sig] = 0
             # the stage must not alias its sources (a loader yielding views
             # of cached arrays would be corrupted by the copy-in below)
             assert not any(
-                np.shares_memory(self._bufs[0][key], b[key])
+                np.shares_memory(self._bufs[sig][0][key], b[key])
                 for b in group for key in group[0])
-        buf = self._bufs[self._i]
-        self._i ^= 1
+        buf = self._bufs[sig][self._i[sig]]
+        self._i[sig] ^= 1
         for i, b in enumerate(group):
             for key in buf:
                 np.copyto(buf[key][i], b[key])
@@ -101,7 +122,7 @@ class _MacroStage:
     def verify(self, host: Batch, uploaded) -> None:
         """First-upload aliasing check: disable reuse if ``uploaded`` still
         reads the staging memory (identity put / zero-copy device_put)."""
-        if self.verified or not self.enabled or self._bufs is None:
+        if self.verified or not self.enabled or not self._bufs:
             return
         self.verified = True
         for key, v in host.items():
@@ -116,7 +137,7 @@ class _MacroStage:
                     continue  # no host view obtainable -> device copy: safe
             if np.shares_memory(v, view):
                 self.enabled = False
-                self._bufs = None
+                self._bufs = {}
                 return
 
 
@@ -128,6 +149,13 @@ def host_macro_batches(loader, k: int, stage: Optional[_MacroStage] = None,
     A fused group assembled through ``stage`` is only valid until the next
     iteration (the buffers are reused) — consumers must upload before
     advancing, which every pipeline and the Trainer's classic path do.
+
+    Fusion is SHAPE-homogeneous: a group only stacks batches of identical
+    shape (the scanned multi-step is one compiled program per shape).
+    Under bucket mode the length-grouped sampler orders batches in
+    ``k``-runs per bucket, so groups straddle a bucket boundary only at
+    bucket tails — those flush as single-step dispatches and the compile
+    count stays ``len(buckets) x {single, fused}``.
     """
     if k <= 1:
         for b in loader:
@@ -136,6 +164,12 @@ def host_macro_batches(loader, k: int, stage: Optional[_MacroStage] = None,
     stage = stage or _MacroStage(k)
     buf = []
     for b in loader:
+        if buf and _seq_of(b) != _seq_of(buf[0]):
+            # bucket boundary: never stack mixed shapes — dispatch the
+            # partial run as singles rather than compile a K'-step variant
+            for x in buf:
+                yield x, 1, False, int(x["example_weight"].sum())
+            buf = []
         buf.append(b)
         if len(buf) == k:
             ex = sum(int(x["example_weight"].sum()) for x in buf)
@@ -224,7 +258,9 @@ class SyncPipeline(InputPipeline):
             if fused:
                 stage.verify(host, dev)
             self.stats.record_batch(
-                n, int(host["example_weight"].size), ex)
+                n, int(host["example_weight"].size), ex,
+                seq_len=_seq_of(host), tokens=int(host["input_ids"].size),
+                tokens_real=_tokens_real(host))
             yield dev, n, fused, ex
 
 
@@ -274,7 +310,11 @@ class DevicePrefetchPipeline(InputPipeline):
                         time.perf_counter() - t0)
                     if fused:
                         stage.verify(host, dev)
-                    q.put((dev, n, fused, ex))  # unbounded: never blocks
+                    # batch telemetry measured from the HOST batch here in
+                    # the worker (the consumer only ever sees device arrays)
+                    meta = (int(host["example_weight"].size), _seq_of(host),
+                            int(host["input_ids"].size), _tokens_real(host))
+                    q.put((dev, n, fused, ex, meta))  # unbounded: no block
                 q.put(done)
             except BaseException as e:  # propagate, don't vanish
                 q.put(e)
@@ -288,10 +328,12 @@ class DevicePrefetchPipeline(InputPipeline):
                     break
                 if isinstance(item, BaseException):
                     raise item
-                dev, n, fused, ex = item
+                dev, n, fused, ex, meta = item
+                rows, seq, tokens, tokens_real = meta
                 self.stats.put_delivered()
-                self.stats.record_batch(
-                    n, int(np.prod(np.shape(dev["example_weight"]))), ex)
+                self.stats.record_batch(n, rows, ex, seq_len=seq,
+                                        tokens=tokens,
+                                        tokens_real=tokens_real)
                 slots.release()  # let the worker upload the NEXT batch now
                 yield dev, n, fused, ex
         finally:
@@ -330,8 +372,28 @@ class DeviceResidentPipeline(InputPipeline):
 
         self.mesh = mesh
         self.rows = loader.batch_size
-        self._gathers: Dict[int, Callable] = {}
+        # gathers keyed (k, seq_len): bucket mode compiles one per
+        # (step-variant, bucket) — bounded, like the step programs.  The
+        # RESIDENCY stays one full-width copy; a bucket batch is the same
+        # gather plus a free on-device column slice, so per-bucket service
+        # costs no extra HBM.
+        self._gathers: Dict[tuple, Callable] = {}
         enc = loader.encoded
+        self._seq = getattr(enc, "seq_len", None)
+        self._lengths = enc.lengths() if hasattr(enc, "lengths") else None
+        # per-row real-example counts (packed rows carry several; plain
+        # encodings one) — host-side, for the transport telemetry only
+        self._row_examples = (
+            (enc.arrays["example_weight"] > 0).sum(1).astype(np.int64)
+            if "example_weight" in enc.arrays else None)
+        # label SLOTS per row (M for packed [N, M] channels, 1 otherwise):
+        # the row-level waste ratio counts slots, matching what sync /
+        # prefetch derive from the host batch's example_weight.size — the
+        # physical row count alone would make rows_real exceed rows under
+        # packing and push the ratio negative
+        self._slots_per_row = (
+            int(enc.arrays["example_weight"].shape[1])
+            if self._row_examples is not None else 1)
         nbytes = sum(v.nbytes for v in enc.arrays.values())
         t0 = time.perf_counter()
         # the one-time residency upload: an amortized h2d_put span (the
@@ -370,7 +432,7 @@ class DeviceResidentPipeline(InputPipeline):
         return jnp.asarray(v)
 
     # ---------------------------------------------------------- the gather
-    def _gather(self, k: int) -> Callable:
+    def _gather(self, k: int, seq_len: int = 0) -> Callable:
         """Jitted ``(arrays, perm, nreal, counter) -> (batch, counter+1)``.
 
         ``perm``: ``[G, k, rows]`` int32 epoch permutation; ``nreal``:
@@ -379,13 +441,22 @@ class DeviceResidentPipeline(InputPipeline):
         host->device bytes.  Filler rows (index padding) are masked to the
         exact zeros ``EncodedDataset.take`` pads with, so the output is
         bitwise the host loader's batch.
+
+        ``seq_len`` (bucket mode) column-slices the full-width token
+        channels to the bucket on device — same bytes ``take(...,
+        seq_len=...)`` produces on host, zero extra residency.  A dataset
+        carrying its own ``example_weight`` channel (packed rows) keeps it:
+        the row mask zeroes filler rows' weights exactly like the host
+        path.
         """
-        if k in self._gathers:
-            return self._gathers[k]
+        key = (k, int(seq_len))
+        if key in self._gathers:
+            return self._gathers[key]
         import jax
         import jax.numpy as jnp
 
         rows = self.rows
+        full = self._seq
 
         def assemble(arrays, perm, nreal, counter):
             idx = jax.lax.dynamic_index_in_dim(perm, counter, 0,
@@ -394,14 +465,18 @@ class DeviceResidentPipeline(InputPipeline):
                                               keepdims=False)    # [k]
             mask = jnp.arange(rows, dtype=jnp.int32)[None, :] < nr[:, None]
             batch = {}
-            for key, v in arrays.items():
+            for akey, v in arrays.items():
                 g = jnp.take(v, idx.reshape(-1), axis=0)
-                g = g.reshape((k, rows) + v.shape[1:])
+                if seq_len and v.ndim == 2 and full and v.shape[1] == full \
+                        and seq_len < full:
+                    g = g[:, :seq_len]
+                g = g.reshape((k, rows) + g.shape[1:])
                 m = mask.reshape(mask.shape + (1,) * (g.ndim - mask.ndim))
                 g = g * m.astype(g.dtype)
-                batch[key] = g[0] if k == 1 else g
-            ew = mask.astype(jnp.float32)
-            batch["example_weight"] = ew[0] if k == 1 else ew
+                batch[akey] = g[0] if k == 1 else g
+            if "example_weight" not in arrays:
+                ew = mask.astype(jnp.float32)
+                batch["example_weight"] = ew[0] if k == 1 else ew
             return batch, counter + 1
 
         if self.mesh is not None:
@@ -412,66 +487,97 @@ class DeviceResidentPipeline(InputPipeline):
             row_spec = (P(DATA_AXIS) if k == 1 else P(None, DATA_AXIS)) \
                 if self.rows % self.mesh.shape.get(DATA_AXIS, 1) == 0 else P()
             batch_sh = NamedSharding(self.mesh, row_spec)
-            out_sh = ({key: batch_sh for key in
-                       list(self.arrays) + ["example_weight"]}, rep)
+            out_sh = ({out_key: batch_sh for out_key in
+                       set(self.arrays) | {"example_weight"}}, rep)
             fn = jax.jit(assemble, out_shardings=out_sh)
         else:
             fn = jax.jit(assemble)
-        self._gathers[k] = fn
+        self._gathers[key] = fn
         return fn
 
     # ------------------------------------------------------------ the epoch
     def macro_batches(self, fuse: int = 1):
         k = max(1, int(fuse))
         chunks = list(self.loader._chunks())  # the sampler's exact chunking
-        steps = len(chunks)
-        if steps == 0:
+        if not chunks:
             return
-        n_fused, n_tail = (steps // k, steps % k) if k > 1 else (0, steps)
-        counts = np.asarray([len(c) for c in chunks], np.int32)
-        padded = np.zeros((steps, self.rows), np.int32)
-        for i, c in enumerate(chunks):
-            padded[i, : len(c)] = c
+        # consecutive same-bucket runs: under the length-grouped sampler a
+        # run is one bucket's stretch of batches; the classic samplers
+        # yield exactly one run (seq 0 = the dataset's full width), which
+        # reproduces the old fused+tail segmentation bit for bit
+        runs: list = []
+        for c, seq in chunks:
+            if not runs or runs[-1][0] != seq:
+                runs.append((seq, []))
+            runs[-1][1].append(c)
 
-        # compile the gather(s) outside the timed upload window
-        gather_f = self._gather(k) if n_fused else None
-        gather_1 = self._gather(1) if n_tail else None
+        # build every segment's gather object first (jit construction is
+        # cheap; compilation happens at first dispatch, not in the timed
+        # upload window), then time the index uploads as ONE amortized
+        # record — whatever the run structure, resident mode's epoch
+        # transport stays a single ~40 KB permutation upload
         t0 = time.perf_counter()
         tr0 = self.tracer.now()
         segments = []
-        if n_fused:
-            segments.append((gather_f, k, n_fused,
-                             self._replicate(
-                                 padded[: n_fused * k].reshape(n_fused, k,
-                                                               self.rows)),
-                             self._replicate(
-                                 counts[: n_fused * k].reshape(n_fused, k)),
-                             counts[: n_fused * k].reshape(n_fused, k)))
-        if n_tail:
-            segments.append((gather_1, 1, n_tail,
-                             self._replicate(
-                                 padded[n_fused * k:].reshape(n_tail, 1,
-                                                              self.rows)),
-                             self._replicate(
-                                 counts[n_fused * k:].reshape(n_tail, 1)),
-                             counts[n_fused * k:].reshape(n_tail, 1)))
+        total_bytes = 4  # the zero counter(s)
+        for seq, cs in runs:
+            steps = len(cs)
+            n_fused, n_tail = (steps // k, steps % k) if k > 1 else (0, steps)
+            counts = np.asarray([len(c) for c in cs], np.int32)
+            padded = np.zeros((steps, self.rows), np.int32)
+            for i, c in enumerate(cs):
+                padded[i, : len(c)] = c
+            total_bytes += padded.nbytes + counts.nbytes
+            if n_fused:
+                segments.append((self._gather(k, seq), k, n_fused, seq,
+                                 self._replicate(
+                                     padded[: n_fused * k].reshape(
+                                         n_fused, k, self.rows)),
+                                 self._replicate(
+                                     counts[: n_fused * k].reshape(
+                                         n_fused, k)),
+                                 cs[: n_fused * k]))
+            if n_tail:
+                segments.append((self._gather(1, seq), 1, n_tail, seq,
+                                 self._replicate(
+                                     padded[n_fused * k:].reshape(
+                                         n_tail, 1, self.rows)),
+                                 self._replicate(
+                                     counts[n_fused * k:].reshape(
+                                         n_tail, 1)),
+                                 cs[n_fused * k:]))
         # the per-epoch permutation-index upload (~40 KB): the ONLY
         # steady-state transport resident mode pays — one amortized
         # h2d_put span per epoch in the trace
         self.tracer.record("h2d_put", tr0, self.tracer.now(),
-                           bytes=padded.nbytes + counts.nbytes + 4,
+                           bytes=total_bytes,
                            in_loop=False, what="epoch_indices")
         self.stats.record_upload(
-            padded.nbytes + counts.nbytes + 4,
+            total_bytes,
             # jaxlint: disable=R4 — host wait of the index upload, by design
             time.perf_counter() - t0, in_loop=False)
 
-        for gather, seg_k, groups, perm, nreal, host_counts in segments:
+        for gather, seg_k, groups, seq, perm, nreal, seg_chunks in segments:
+            seq_eff = int(seq) if seq else int(self._seq or 0)
+            # telemetry precomputed per segment (one host pass per epoch,
+            # len(seg_chunks) == groups * seg_k by construction): the
+            # dispatch loop below stays O(1) host work per group
+            ex_g = np.asarray(
+                [self._row_examples[c].sum()
+                 if self._row_examples is not None else len(c)
+                 for c in seg_chunks], np.int64).reshape(groups, seg_k).sum(1)
+            tok_g = np.asarray(
+                [self._lengths[c].sum() if self._lengths is not None else 0
+                 for c in seg_chunks], np.int64).reshape(groups, seg_k).sum(1)
             counter = self._replicate(np.int32(0))
             for g in range(groups):
                 batch, counter = gather(self.arrays, perm, nreal, counter)
-                ex = int(host_counts[g].sum())
-                self.stats.record_batch(seg_k, seg_k * self.rows, ex)
+                ex = int(ex_g[g])
+                self.stats.record_batch(
+                    seg_k, seg_k * self.rows * self._slots_per_row, ex,
+                    seq_len=seq_eff,
+                    tokens=seg_k * self.rows * seq_eff,
+                    tokens_real=int(tok_g[g]))
                 yield batch, seg_k, seg_k > 1, ex
 
 

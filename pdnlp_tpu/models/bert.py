@@ -177,6 +177,7 @@ def encode(
     attn_impl: str = "xla",
     seq_axis: Optional[str] = None,
     attn_bias: Optional[jax.Array] = None,
+    position_ids: Optional[jax.Array] = None,
     unroll=True,
     with_aux: bool = False,
 ) -> jax.Array:
@@ -195,9 +196,13 @@ def encode(
     axis (``ops.ring``) — the long-context sequence-parallel path.
 
     ``attn_bias``: optional additive bias broadcastable to [B, N, S, S]
-    that *replaces* the mask-derived bias — used by the packed-MLM
-    pretraining path for its block-diagonal segment mask
-    (``data.packing.segment_bias``).
+    that *replaces* the mask-derived bias — used by the packed paths
+    (MLM pretraining and packed classification) for their block-diagonal
+    segment mask (``data.packing.segment_bias``).
+
+    ``position_ids``: optional explicit [B, S] position-embedding indices
+    (packed rows restart positions per segment); default is the row
+    position ``arange(S)`` every unpacked batch uses.
     """
     B, S = input_ids.shape
     shard_offset = 0
@@ -211,7 +216,7 @@ def encode(
             "JAX gather would silently clamp position embeddings")
     x, rng = embed(params, cfg, input_ids, token_type_ids, dtype=dtype,
                    deterministic=deterministic, rng=rng,
-                   shard_offset=shard_offset)
+                   shard_offset=shard_offset, position_ids=position_ids)
 
     ring_bias = bias = None
     if attn_bias is not None:
@@ -236,16 +241,20 @@ def encode(
 def embed(params: Params, cfg: BertConfig, input_ids: jax.Array,
           token_type_ids: jax.Array, *, dtype=jnp.float32,
           deterministic: bool = True, rng: Optional[jax.Array] = None,
-          shard_offset=0):
+          shard_offset=0, position_ids: Optional[jax.Array] = None):
     """Embedding sum + LayerNorm + dropout; returns ``(x, rng)`` with the
     embedding dropout's split consumed, so layer streams continue from the
     returned key exactly as they did when this lived inline in ``encode``.
-    Public so the pipeline-parallel path can run it on its first stage."""
+    Public so the pipeline-parallel path can run it on its first stage.
+    ``position_ids`` overrides the row-position ``arange`` (packed rows
+    restart positions per segment)."""
     S = input_ids.shape[1]
     emb = params["embeddings"]
+    pos = (emb["position"][position_ids] if position_ids is not None
+           else emb["position"][jnp.arange(S) + shard_offset])
     x = (
         emb["word"][input_ids]
-        + emb["position"][jnp.arange(S) + shard_offset]
+        + pos
         + emb["token_type"][token_type_ids]
     ).astype(dtype)
     x = _layer_norm(x, emb["ln"]["scale"], emb["ln"]["bias"], cfg.layer_norm_eps)
@@ -540,17 +549,48 @@ def classify(
     shard 0; a masked ``psum`` broadcasts it so every shard computes the
     same logits.  Attention-probability dropout runs per ring block
     (``ops.ring``) — same distribution as the dense path, shard-layout-
-    dependent draws."""
+    dependent draws.
+
+    A PACKED batch (``--length_mode pack``: ``segment_ids`` +
+    ``cls_positions`` channels, ``data.packing.PackedClassificationDataset``)
+    carries several examples per row: attention gets the block-diagonal
+    ``segment_bias`` so examples never cross-attend, each segment's [CLS]
+    hidden state is gathered at its ``cls_positions`` offset, and the head
+    returns per-SEGMENT logits ``[B, M, num_labels]`` (labels/weights in
+    the batch are ``[B, M]`` to match) — per-example semantics, packed
+    compute.  The batch-key check is trace-static (dict structure, not
+    values): packed and unpacked batches are separate compiled programs."""
+    packed = "cls_positions" in batch
+    if packed and seq_axis is not None:
+        raise ValueError("packed classification rows are not supported on "
+                         "the sequence-parallel (ring attention) path — "
+                         "the block-diagonal bias cannot ride the ring")
     if not deterministic:
         rng, enc_rng, drop_rng = jax.random.split(rng, 3)
     else:
         enc_rng = drop_rng = None
+    attn_bias = None
+    if packed:
+        from pdnlp_tpu.data.packing import segment_bias
+
+        attn_bias = segment_bias(batch["segment_ids"], dtype=jnp.float32)
     hidden, aux = encode(
         params, cfg,
         batch["input_ids"], batch["token_type_ids"], batch["attention_mask"],
         dtype=dtype, deterministic=deterministic, rng=enc_rng, remat=remat,
-        attn_impl=attn_impl, seq_axis=seq_axis, unroll=unroll, with_aux=True,
+        attn_impl=attn_impl, seq_axis=seq_axis, attn_bias=attn_bias,
+        position_ids=batch.get("position_ids") if packed else None,
+        unroll=unroll, with_aux=True,
     )
+    if packed:
+        # per-segment pooled-output gather: [B, S, H] at [B, M] offsets
+        pos = batch["cls_positions"].astype(jnp.int32)
+        hM = jnp.take_along_axis(hidden, pos[..., None], axis=1)  # [B, M, H]
+        B, M, H = hM.shape
+        logits = pooled_logits(params, cfg, hM.reshape(B * M, H), dtype=dtype,
+                               drop_rng=None if deterministic else drop_rng)
+        logits = logits.reshape(B, M, -1)
+        return (logits, aux) if return_aux else logits
     h0 = hidden[:, 0, :]
     if seq_axis is not None:
         on_shard0 = (jax.lax.axis_index(seq_axis) == 0).astype(h0.dtype)

@@ -42,6 +42,15 @@ PHASES = ("data_wait", "h2d_put", "step_dispatch", "device_block",
 STEP_END_PHASE = "device_block"
 
 
+def _bucket_key(bucket) -> tuple:
+    """Numeric-aware sort for bucket labels: widths 16/32/64/128 order by
+    VALUE (a plain string sort reads 128 < 16), non-numeric labels after."""
+    try:
+        return (0, int(bucket), "")
+    except (TypeError, ValueError):
+        return (1, 0, str(bucket))
+
+
 def _percentile(sorted_vals: Sequence[float], p: float) -> float:
     """Exact percentile over a sorted list (numpy-free: the CLI must run
     without the training stack)."""
@@ -84,6 +93,10 @@ class StepBreakdown:
         self.groups = 0           # dispatch groups (= observations)
         self._current: Dict[str, float] = {}
         self._per_phase: Dict[str, List[float]] = {}
+        # per-bucket (the closing record's ``bucket`` attr, e.g. the batch
+        # token width under --length_mode bucket) phase totals: the
+        # end-of-train table breaks the step phases down per bucket
+        self._per_bucket: Dict[object, Dict] = {}
         self._count = 0
         # feed() runs on whichever thread RECORDED the span (tracer
         # listeners fire in-line) — the prefetch worker's h2d_put races the
@@ -128,7 +141,8 @@ class StepBreakdown:
                 + max(0.0, dur)
             if name == STEP_END_PHASE:
                 attrs = record.get("attrs") or {}
-                self._close_step(attrs.get("step"), int(attrs.get("n", 1)))
+                self._close_step(attrs.get("step"), int(attrs.get("n", 1)),
+                                 bucket=attrs.get("bucket"))
 
     def record(self, phase: str, seconds: float) -> None:
         """Direct accumulation into the open step (tests / non-span use)."""
@@ -141,7 +155,8 @@ class StepBreakdown:
         with self._lock:
             self._close_step(step, n)
 
-    def _close_step(self, step: Optional[int], n: int) -> None:
+    def _close_step(self, step: Optional[int], n: int,
+                    bucket=None) -> None:
         # caller holds self._lock
         phases = self._current
         self._current = {}
@@ -151,6 +166,13 @@ class StepBreakdown:
         self._count = int(step) if step is not None else self._count + n
         for phase, sec in phases.items():
             self._per_phase.setdefault(phase, []).append(sec)
+        if bucket is not None and n > 0:
+            b = self._per_bucket.setdefault(
+                bucket, {"steps": 0, "groups": 0, "phases": {}})
+            b["steps"] += int(n)
+            b["groups"] += 1
+            for phase, sec in phases.items():
+                b["phases"][phase] = b["phases"].get(phase, 0.0) + sec
         if self.on_step is not None:
             self.on_step(self._count, phases, sum(phases.values()))
 
@@ -178,7 +200,25 @@ class StepBreakdown:
                 "p95_sec": round(_percentile(s, 95), 9),
                 "share": round(total / grand, 4),
             }
-        return {"steps": self.steps, "groups": self.groups, "phases": phases}
+        out = {"steps": self.steps, "groups": self.groups, "phases": phases}
+        if self._per_bucket:
+            out["by_bucket"] = {
+                str(bucket): {
+                    "steps": b["steps"],
+                    "groups": b["groups"],
+                    "phases": {
+                        phase: {
+                            "total_sec": round(sec, 6),
+                            "mean_sec": round(sec / b["groups"], 9),
+                        }
+                        for phase, sec in sorted(b["phases"].items(),
+                                                 key=lambda kv: -kv[1])
+                    },
+                }
+                for bucket, b in sorted(self._per_bucket.items(),
+                                        key=lambda kv: _bucket_key(kv[0]))
+            }
+        return out
 
     @staticmethod
     def from_records(records: Sequence[Dict]) -> "StepBreakdown":
@@ -204,4 +244,13 @@ def format_table(summary: Dict) -> str:
             f"{s['p95_sec'] * 1e3:>10.3f} {s['share']:>6.1%}")
     lines.append(f"steps: {summary.get('steps', 0)}  "
                  f"dispatch groups: {summary.get('groups', 0)}")
+    # per-bucket breakdown (length-aware runs): one line per bucket x
+    # phase so a bucketed run's table shows where each width's time goes
+    for bucket, b in summary.get("by_bucket", {}).items():
+        lines.append(f"bucket {bucket}: {b['steps']} steps / "
+                     f"{b['groups']} groups")
+        for phase, s in b["phases"].items():
+            lines.append(
+                f"  {phase:<12} {s['total_sec']:>10.3f}s total "
+                f"{s['mean_sec'] * 1e3:>10.3f} ms/group")
     return "\n".join(lines)

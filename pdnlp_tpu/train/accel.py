@@ -136,7 +136,29 @@ class Accelerator:
         prepared = []
         for loader in loaders:
             sampler = loader.sampler
-            if jax.process_count() > 1 and sampler.num_shards != jax.process_count():
+            if hasattr(sampler, "chunks"):
+                # A batching sampler (LengthGroupedSampler) owns the chunk
+                # size: re-batching the loader without rebuilding it would
+                # leave every chunk at the UNSCALED batch size — take()
+                # pads to batch*mult, so (mult-1)/mult of each batch would
+                # be zero-weight filler, a silent mult× throughput loss.
+                # Rebuild it at the scaled batch (and, multi-process, on
+                # this host's shard of the SAME seeded global batches).
+                from pdnlp_tpu.data.sampler import LengthGroupedSampler
+
+                multi = jax.process_count() > 1
+                sampler = LengthGroupedSampler(
+                    sampler.lengths, loader.batch_size * mult,
+                    buckets=sampler.buckets,
+                    num_shards=jax.process_count() if multi
+                    else sampler.num_shards,
+                    shard_id=jax.process_index() if multi
+                    else sampler.shard_id,
+                    shuffle=sampler.shuffle, seed=sampler.seed,
+                    drop_last=sampler.drop_last,
+                )
+            elif jax.process_count() > 1 and \
+                    sampler.num_shards != jax.process_count():
                 # Multi-process: each host must feed a DISJOINT shard, or
                 # make_array_from_process_local_data assembles a global batch
                 # of process_count duplicates (the reference's sampler-less

@@ -57,6 +57,14 @@ def build_parallel_trainer(
                          "strategies, not shard_map, fused multi-steps, or "
                          "tp — the staged host<->device transfers are only "
                          "wired into the plain data-axis train step")
+    from pdnlp_tpu.data.sampler import resolve_length_mode
+
+    if explicit_collectives and resolve_length_mode(args) != "full":
+        raise ValueError(
+            "--length_mode bucket/pack is wired into the jit strategies "
+            "(shapes re-specialize per bucket; packed batches carry extra "
+            "channels) — the hand-written shard_map step compiles one "
+            "fixed-shape program; use the dp/zero jit path instead")
     if scale_batch:
         # which slice of the global batch this process feeds — handles both
         # a data axis split across processes (dp/zero: each host its shard)
@@ -125,6 +133,7 @@ def build_sp_trainer(args: Args, mesh=None):
     spans processes, the data axis is process-local, every process feeds the
     full global batch, and ``make_sp_batch`` hands each device its sequence
     slice (the ring's ``ppermute`` then crosses the process boundary)."""
+    from pdnlp_tpu.data.sampler import resolve_length_mode
     from pdnlp_tpu.parallel import init_runtime, make_mesh
     from pdnlp_tpu.parallel.mesh import local_data_extent
     from pdnlp_tpu.parallel.sp import (
@@ -132,6 +141,12 @@ def build_sp_trainer(args: Args, mesh=None):
     )
     from pdnlp_tpu.train.setup import setup_model
 
+    if resolve_length_mode(args) != "full":
+        raise ValueError(
+            "--length_mode bucket/pack is not supported on the sequence-"
+            "parallel path: the ring slices one fixed global sequence "
+            "across devices, and the packed block-diagonal bias cannot "
+            "ride the ring — use the dp/zero strategies")
     if mesh is None:
         init_runtime(args)
         shape = args.mesh_shape or {"data": 1, SEQ: len(jax.devices())}
@@ -181,6 +196,7 @@ def build_pipeline_trainer(args: Args, mesh=None):
     mesh whose ``stage`` (and optionally ``data``) axes span processes, each
     process feeds its data shard (or the full batch when there is no data
     axis — the batch is then replicated, stages exchange activations)."""
+    from pdnlp_tpu.data.sampler import resolve_length_mode
     from pdnlp_tpu.parallel.pp import (
         STAGE, make_pp_batch, make_pp_eval_step, make_pp_train_step,
         setup_pp_model,
@@ -188,6 +204,12 @@ def build_pipeline_trainer(args: Args, mesh=None):
     from pdnlp_tpu.parallel import init_runtime, make_mesh
     from pdnlp_tpu.parallel.mesh import local_data_extent
 
+    if resolve_length_mode(args) != "full":
+        raise ValueError(
+            "--length_mode bucket/pack is not supported on the pipeline "
+            "(GPipe) path: stages compile one fixed microbatch shape and "
+            "the per-segment head gather lives on the last stage only — "
+            "use the dp/zero strategies")
     if mesh is None:
         init_runtime(args)
         shape = args.mesh_shape or {STAGE: len(jax.devices())}

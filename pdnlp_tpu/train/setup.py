@@ -49,18 +49,65 @@ def setup_data(args, *, num_shards: int = 1, shard_id: int = 0,
     # reference-semantics spec and the parity test pins them equal)
     train_enc = EncodedDataset(train, tok, args.max_seq_len)
     dev_enc = EncodedDataset(dev, tok, args.max_seq_len)
-    train_loader = DataLoader(
-        train, col, args.train_batch_size * device_batch_mult,
-        sampler=DistributedShardSampler(len(train), num_shards, shard_id,
-                                        shuffle=True, seed=args.seed),
-        prefetch=args.prefetch, encoded=train_enc,
-    )
+    train_loader = build_length_train_loader(
+        args, train, col, train_enc,
+        batch_size=args.train_batch_size * device_batch_mult,
+        num_shards=num_shards, shard_id=shard_id)
     dev_loader = DataLoader(
         dev, col, args.dev_batch_size * device_batch_mult,
         sampler=DistributedShardSampler(len(dev), num_shards, shard_id, shuffle=False),
         prefetch=args.prefetch, encoded=dev_enc,
     )
     return train_loader, dev_loader, tok
+
+
+def build_length_train_loader(args, train, col, train_enc, *, batch_size,
+                              num_shards: int = 1, shard_id: int = 0):
+    """The train ``DataLoader`` under ``--length_mode`` — ONE place, shared
+    by ``setup_data`` and ``bench.py --length``, so the mode wiring cannot
+    drift between the entrypoints and the smoke that measures them.
+
+    - ``full``: the reference path — seeded shard sampler, every batch
+      padded to ``max_seq_len``.
+    - ``bucket``: seeded length-grouped sampler; each batch pads to the
+      smallest bucket covering its longest example, batches stay
+      bucket-homogeneous (and ``fuse_steps`` groups shape-homogeneous).
+    - ``pack``: the split is packed once into multi-example rows
+      (``data.packing``); epochs shuffle packed rows through the ordinary
+      shard sampler — one static shape, ~1/segments-per-row the steps.
+
+    Eval loaders stay unpacked/full-width in every mode: eval semantics
+    (and the dev-accuracy definition) never change with the training
+    layout.
+    """
+    from pdnlp_tpu.data.packing import pack_classification
+    from pdnlp_tpu.data.sampler import (
+        LengthGroupedSampler, parse_buckets, resolve_length_mode,
+    )
+
+    mode = resolve_length_mode(args)
+    if mode == "bucket":
+        sampler = LengthGroupedSampler(
+            train_enc.lengths(), batch_size=batch_size,
+            buckets=parse_buckets(args.length_buckets, args.max_seq_len),
+            num_shards=num_shards, shard_id=shard_id, shuffle=True,
+            seed=args.seed)
+        return DataLoader(train, col, batch_size, sampler=sampler,
+                          prefetch=args.prefetch, encoded=train_enc)
+    if mode == "pack":
+        packed = pack_classification(
+            train_enc, max_segments=getattr(args, "pack_max_segments", 16))
+        return DataLoader(
+            train, col, batch_size,
+            sampler=DistributedShardSampler(len(packed), num_shards,
+                                            shard_id, shuffle=True,
+                                            seed=args.seed),
+            prefetch=args.prefetch, encoded=packed)
+    return DataLoader(
+        train, col, batch_size,
+        sampler=DistributedShardSampler(len(train), num_shards, shard_id,
+                                        shuffle=True, seed=args.seed),
+        prefetch=args.prefetch, encoded=train_enc)
 
 
 def setup_pipeline(args, loader, put=None, put_fused=None, mesh=None,

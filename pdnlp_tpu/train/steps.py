@@ -133,9 +133,17 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
             params, cfg, batch, dtype=dtype, deterministic=False, rng=rng,
             remat=remat, attn_impl=attn_impl, unroll=unroll, return_aux=True,
         )
+        # packed rows return per-SEGMENT logits [B, M, C] with [B, M]
+        # labels/weights: flatten to the per-example stream — the weighted
+        # CE below is then exactly the unpacked loss over the same
+        # examples (empty slots weigh 0, like filler rows)
+        labels, weights = batch["label"], batch["example_weight"]
+        if logits.ndim == 3:
+            logits = logits.reshape(-1, logits.shape[-1])
+            labels = labels.reshape(-1)
+            weights = weights.reshape(-1)
         loss, correct, objective = weighted_ce(
-            logits, batch["label"], batch["example_weight"],
-            smoothing=smoothing)
+            logits, labels, weights, smoothing=smoothing)
         return objective + cfg.moe_aux_coef * aux, (loss, correct)
 
     ema_decay = getattr(args, "ema_decay", 0.0)
@@ -249,8 +257,12 @@ def build_eval_step(cfg: BertConfig, args) -> Callable[..., Metrics]:
         logits = bert.classify(params, cfg, batch, dtype=dtype,
                                deterministic=True, attn_impl=attn_impl,
                                unroll=unroll)
-        w = batch["example_weight"]
-        loss, correct, _ = weighted_ce(logits, batch["label"], w)
+        labels, w = batch["label"], batch["example_weight"]
+        if logits.ndim == 3:  # packed rows: per-segment -> per-example
+            logits = logits.reshape(-1, logits.shape[-1])
+            labels = labels.reshape(-1)
+            w = w.reshape(-1)
+        loss, correct, _ = weighted_ce(logits, labels, w)
         return {
             "loss_sum": loss * jnp.maximum(w.sum(), 1.0),
             "weight": w.sum(),
@@ -260,7 +272,7 @@ def build_eval_step(cfg: BertConfig, args) -> Callable[..., Metrics]:
             # replicated outputs this is the all-gather that lets every host
             # assemble the full (pred, label) stream for the report
             # (multi-gpu-distributed-cls.py:145-155).
-            "label": batch["label"],
+            "label": labels,
             "ew": w,
         }
 

@@ -342,6 +342,12 @@ class Trainer:
                     if fault_step and start_step == 0 and gstep >= fault_step \
                             and jax.process_index() == fault_proc:
                         os._exit(13)
+                    # bucket attr on the dispatch/block spans: the obs
+                    # breakdown splits step phases per token width, so a
+                    # bucketed run's phase table shows where each bucket's
+                    # time goes (int() — shape dims must not leak numpy
+                    # scalars into span attrs)
+                    seq = int(batch["input_ids"].shape[-1])
                     if fused:
                         if use_pipe:
                             dev = batch
@@ -350,7 +356,8 @@ class Trainer:
                                 dev = self.put_fused(batch)
                             if stage is not None:
                                 stage.verify(batch, dev)  # aliasing guard, once
-                        with tr.span("step_dispatch", step=gstep + n, n=n):
+                        with tr.span("step_dispatch", step=gstep + n, n=n,
+                                     bucket=seq):
                             self.state, metrics = self.multi_step(self.state, dev)
                         last_loss = metrics["loss"][-1]
                     else:
@@ -359,14 +366,15 @@ class Trainer:
                         else:
                             with tr.span("h2d_put", step=gstep + n):
                                 dev = self.put(batch)
-                        with tr.span("step_dispatch", step=gstep + n, n=n):
+                        with tr.span("step_dispatch", step=gstep + n, n=n,
+                                     bucket=seq):
                             self.state, metrics = self.train_step(self.state, dev)
                         last_loss = metrics["loss"]
                     # traced runs attribute device time to a separate
                     # device_block span (dispatch above measured enqueue only);
                     # untraced runs keep the async discipline — block is a
                     # no-op on a disabled tracer, never a hidden barrier
-                    tr.block(last_loss, step=gstep + n, n=n)
+                    tr.block(last_loss, step=gstep + n, n=n, bucket=seq)
                     prev = gstep
                     gstep += n
                     examples += n_examples

@@ -130,6 +130,29 @@ class Args:
                                                   # file (JSON/YAML, the
                                                   # default_config.yaml
                                                   # analog — accel.py)
+    length_mode: str = "auto"                     # length-aware training
+                                                  # (data/sampler.py):
+                                                  # full (pad every batch to
+                                                  # max_seq_len — reference
+                                                  # semantics) | bucket
+                                                  # (length-grouped batches
+                                                  # padded to the smallest
+                                                  # covering bucket) | pack
+                                                  # (multiple examples per
+                                                  # row, block-diagonal
+                                                  # attention).  auto = full:
+                                                  # bucket/pack change batch
+                                                  # COMPOSITION (not per-
+                                                  # example math), so they
+                                                  # are opt-in; bench.py
+                                                  # --length measures the win
+    length_buckets: str = "32,64,128"             # bucket widths; values over
+                                                  # max_seq_len are dropped
+                                                  # and max_seq_len is always
+                                                  # the last bucket
+    pack_max_segments: int = 16                   # examples per packed row
+                                                  # cap (static shape of the
+                                                  # per-segment channels)
     prefetch: int = 2                             # loader collation lookahead
     pipeline: str = "auto"                        # input pipeline (data/
                                                   # pipeline.py): auto|

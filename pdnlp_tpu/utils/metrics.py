@@ -134,6 +134,9 @@ class TransportStats:
         self.steps = 0              # optimizer steps fed
         self.rows = 0               # batch rows fed (incl. filler padding)
         self.rows_real = 0          # weight-1 rows (real examples)
+        self.tokens = 0             # token positions fed (rows x seq_len)
+        self.tokens_real = 0        # attention-mask-1 positions (non-[PAD])
+        self.by_bucket: Dict[int, Dict[str, int]] = {}  # seq_len -> counters
         self.in_flight = 0          # uploaded but not yet handed to the loop
         self.in_flight_max = 0
 
@@ -148,11 +151,30 @@ class TransportStats:
             else:
                 self.puts_amortized += 1
 
-    def record_batch(self, steps: int, rows: int, rows_real: int) -> None:
+    def record_batch(self, steps: int, rows: int, rows_real: int,
+                     seq_len: int = 0, tokens: int = 0,
+                     tokens_real: int = 0) -> None:
+        """``seq_len``/``tokens``/``tokens_real`` feed the token-level
+        padding-waste accounting (and its per-``seq_len``-bucket breakdown)
+        the length-aware modes exist to move: ``tokens`` positions were
+        paid for (batch input rows x width — under packing that is FEWER
+        than the example count suggests), ``tokens_real`` were non-[PAD]."""
         with self._lock:
             self.steps += int(steps)
             self.rows += int(rows)
             self.rows_real += int(rows_real)
+            if seq_len:
+                self.tokens += int(tokens)
+                self.tokens_real += int(tokens_real)
+                b = self.by_bucket.setdefault(
+                    int(seq_len),
+                    {"steps": 0, "rows": 0, "rows_real": 0, "tokens": 0,
+                     "tokens_real": 0})
+                b["steps"] += int(steps)
+                b["rows"] += int(rows)
+                b["rows_real"] += int(rows_real)
+                b["tokens"] += int(tokens)
+                b["tokens_real"] += int(tokens_real)
 
     def put_started(self) -> None:
         with self._lock:
@@ -174,10 +196,17 @@ class TransportStats:
         """Fraction of fed rows that were zero-weight filler."""
         return 1.0 - self.rows_real / self.rows if self.rows else 0.0
 
+    @property
+    def padding_waste_tokens(self) -> float:
+        """Fraction of fed token POSITIONS that were [PAD] — the FLOP
+        waste the length-aware modes (bucket/pack) attack.  0.0 until a
+        caller supplies ``seq_len``/``tokens_real`` to ``record_batch``."""
+        return 1.0 - self.tokens_real / self.tokens if self.tokens else 0.0
+
     def snapshot(self) -> Dict[str, object]:
         """JSON-ready summary (the bench's ``transport`` block)."""
         with self._lock:
-            return {
+            snap = {
                 "mode": self.mode,
                 "steps": self.steps,
                 "puts_in_loop": self.puts_in_loop,
@@ -190,8 +219,22 @@ class TransportStats:
                 "padding_waste_ratio": round(
                     1.0 - self.rows_real / self.rows, 6) if self.rows
                 else 0.0,
+                "padding_waste_tokens": round(
+                    1.0 - self.tokens_real / self.tokens, 6) if self.tokens
+                else None,
                 "prefetch_in_flight_max": self.in_flight_max,
             }
+            if self.by_bucket:
+                snap["by_bucket"] = {
+                    str(seq): {
+                        **b,
+                        "padding_waste_tokens": round(
+                            1.0 - b["tokens_real"] / b["tokens"], 6)
+                        if b["tokens"] else 0.0,
+                    }
+                    for seq, b in sorted(self.by_bucket.items())
+                }
+            return snap
 
 
 def per_class_stats(y_true: Sequence[int], y_pred: Sequence[int], num_classes: int):

@@ -124,22 +124,37 @@ def test_loader_mid_epoch_break_tears_down_bounded(data, tok):
     """Regression: abandoning iteration mid-epoch must stop the worker in
     ONE bounded join — including the case where the worker is parked on
     the SENTINEL put (a full queue after the last batch), which the old
-    unbounded ``q.put(_SENTINEL)`` + drain busy-spin could strand."""
+    unbounded ``q.put(_SENTINEL)`` + drain busy-spin could strand.
+
+    Deflaked: no blind warm-up sleep.  ``_chunks`` resumes past its last
+    yield only after the final batch's put has SUCCEEDED, so an event set
+    there means the worker's next act is the sentinel put — the stranding
+    state is reached by construction, not by hoping 0.3 s was enough under
+    CPU contention.  (Old code fails either way: an unbounded sentinel put
+    attempted after close() strands the thread and trips the count check.)"""
     import threading
     import time
 
     col = Collator(tok, max_seq_len=16)
+
+    class ExhaustSignal(DataLoader):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            self.exhausted = threading.Event()
+
+        def _chunks(self):
+            yield from super()._chunks()
+            self.exhausted.set()
+
     before = threading.active_count()
     # two batches, prefetch=1: after the consumer takes batch 0, the worker
-    # lands blocked putting the sentinel behind the queued batch 1
-    loader = DataLoader(data[:64], col, batch_size=32, prefetch=1)
+    # queues batch 1 (full again) and parks on the sentinel put behind it
+    loader = ExhaustSignal(data[:64], col, batch_size=32, prefetch=1)
     it = iter(loader)
     next(it)
-    time.sleep(0.3)  # let the worker reach the blocked sentinel put
-    t0 = time.monotonic()
+    assert loader.exhausted.wait(timeout=30.0), "worker never exhausted"
     it.close()       # generator finally: stop + one bounded join
-    assert time.monotonic() - t0 < 2.5
-    deadline = time.monotonic() + 2.0
+    deadline = time.monotonic() + 10.0
     while threading.active_count() > before and time.monotonic() < deadline:
         time.sleep(0.02)
     assert threading.active_count() <= before

@@ -1,0 +1,361 @@
+"""Length-aware training tests (``--length_mode bucket|pack``).
+
+The numerics bars are the strongest the math allows:
+
+- **pad-width invariance** — a batch padded to 32 and to 128 yields
+  identical argmax and logits within float tolerance, end to end through
+  the encoder: pins that ``mask_bias`` fully neutralizes pad positions.
+- **packed-vs-unpacked parity** — every segment of a packed row computes
+  the SAME logits its example computes unpacked (block-diagonal
+  ``segment_bias`` + per-segment positions restarting at 0), so packing
+  changes FLOPs, never per-example semantics.
+- **sampler/packing invariants** — exactly-once coverage, deterministic
+  process sharding, bucket homogeneity, epoch-invariant batch counts.
+- **pipeline parity** — bucket/pack epochs through the device-resident
+  pipeline are bitwise the sync pipeline's (losses equal as floats).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pdnlp_tpu.data import Collator, DataLoader, WordPieceTokenizer, build_vocab
+from pdnlp_tpu.data.collate import EncodedDataset
+from pdnlp_tpu.data.packing import pack_classification
+from pdnlp_tpu.data.pipeline import build_pipeline
+from pdnlp_tpu.data.sampler import (
+    LengthGroupedSampler, parse_buckets, resolve_length_mode,
+)
+from pdnlp_tpu.models import bert, get_config
+from pdnlp_tpu.train.optim import build_optimizer
+from pdnlp_tpu.train.setup import build_length_train_loader
+from pdnlp_tpu.train.steps import (
+    init_state, make_eval_step, make_multi_step, make_train_step,
+)
+from pdnlp_tpu.utils.config import Args
+
+S = 128
+BATCH = 8
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """Deterministic mixed-length corpus: mostly short (the real corpus's
+    shape), with mid and long tails so every bucket is populated."""
+    rng = np.random.RandomState(11)
+    chars = "天地人你我他好坏大小上下来去爱恨喜怒哀乐"
+    data = []
+    for i in range(180):
+        n = int(rng.choice([4, 7, 11, 16, 24, 40, 70, 100],
+                           p=[.2, .2, .2, .1, .1, .1, .05, .05]))
+        text = "".join(rng.choice(list(chars)) for _ in range(n))
+        data.append((text, int(rng.randint(0, 6))))
+    return data
+
+
+@pytest.fixture(scope="module")
+def tok(corpus):
+    return WordPieceTokenizer(build_vocab((t for t, _ in corpus), size=128))
+
+
+@pytest.fixture(scope="module")
+def enc(corpus, tok):
+    return EncodedDataset(corpus, tok, S)
+
+
+@pytest.fixture(scope="module")
+def model(tok):
+    cfg = get_config("bert-tiny", vocab_size=tok.vocab_size, num_labels=6,
+                     dropout=0.0, attn_dropout=0.0)
+    params = bert.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+# ------------------------------------------------------------- mode resolve
+
+def test_resolve_length_mode_auto_is_full():
+    assert resolve_length_mode(Args()) == "full"
+    assert resolve_length_mode(Args(length_mode="bucket")) == "bucket"
+    with pytest.raises(ValueError):
+        resolve_length_mode(Args(length_mode="typo"))
+
+
+def test_parse_buckets_clips_and_caps():
+    assert parse_buckets("32,64,128", 128) == (32, 64, 128)
+    # widths over max_seq_len drop; max_seq_len always the last bucket
+    assert parse_buckets("32,64,128", 64) == (32, 64)
+    assert parse_buckets("16", 32) == (16, 32)
+    with pytest.raises(ValueError):
+        parse_buckets("32,x", 128)
+
+
+# ------------------------------------------------------- sampler invariants
+
+def test_length_sampler_covers_every_example_once_and_shards(enc):
+    buckets = parse_buckets("32,64,128", S)
+    shards = [LengthGroupedSampler(enc.lengths(), batch_size=4,
+                                   buckets=buckets, num_shards=2, shard_id=i,
+                                   seed=5)
+              for i in range(2)]
+    seqs = [list(s.chunks()) for s in shards]
+    # same batch count and the same bucket at every global step
+    assert len(seqs[0]) == len(seqs[1]) == shards[0].batches_per_epoch
+    assert [b for _, b in seqs[0]] == [b for _, b in seqs[1]]
+    # disjoint cover: every example exactly once across the shards
+    flat = [i for sq in seqs for c, _ in sq for i in c]
+    assert sorted(flat) == list(range(len(enc)))
+    # bucket homogeneity: every member's length fits its batch's bucket
+    L = enc.lengths()
+    for sq in seqs:
+        for chunk, bucket in sq:
+            assert all(L[i] <= bucket for i in chunk)
+
+
+def test_length_sampler_epoch_reshuffles_but_structure_is_invariant(enc):
+    s = LengthGroupedSampler(enc.lengths(), batch_size=4,
+                             buckets=parse_buckets("32,64,128", S), seed=5)
+    s.set_epoch(0)
+    e0 = list(s.chunks())
+    s.set_epoch(1)
+    e1 = list(s.chunks())
+    # membership-derived structure is epoch-invariant (resume + compile
+    # bounds depend on it): same count, same per-bucket batch counts
+    assert len(e0) == len(e1) == s.batches_per_epoch
+
+    def hist(sq):
+        h = {}
+        for c, b in sq:
+            h[b] = h.get(b, 0) + 1
+        return h
+
+    assert hist(e0) == hist(e1)
+    # ... but the composition reshuffles
+    assert [c for c, _ in e0] != [c for c, _ in e1]
+    # and within one bucket every epoch covers the same member set
+    for b in hist(e0):
+        m0 = sorted(i for c, bb in e0 if bb == b for i in c)
+        m1 = sorted(i for c, bb in e1 if bb == b for i in c)
+        assert m0 == m1
+
+
+# ---------------------------------------------------------------- packing
+
+def test_packing_covers_every_example_once_with_labels(corpus, enc):
+    packed = pack_classification(enc, max_segments=8)
+    w = packed.arrays["example_weight"] > 0
+    assert int(w.sum()) == len(corpus)
+    from collections import Counter
+
+    assert Counter(packed.arrays["label"][w].tolist()) == \
+        Counter(l for _, l in corpus)
+    # every real segment's cls_position points at a [CLS] token and
+    # positions restart per segment
+    ii, cp = packed.arrays["input_ids"], packed.arrays["cls_positions"]
+    pos = packed.arrays["position_ids"]
+    tok_cls = ii[0, 0]
+    for r in range(packed.n):
+        for s_ in range(8):
+            if w[r, s_]:
+                assert ii[r, cp[r, s_]] == tok_cls
+                assert pos[r, cp[r, s_]] == 0
+    # rows respect the token budget and the segment cap
+    assert packed.arrays["segment_ids"].max() <= 8
+    assert (packed.arrays["attention_mask"].sum(1) <= S).all()
+
+
+def test_packing_respects_segment_cap(enc):
+    packed = pack_classification(enc, max_segments=2)
+    assert packed.arrays["segment_ids"].max() <= 2
+    assert int((packed.arrays["example_weight"] > 0).sum()) == len(enc)
+
+
+# ------------------------------------------------------------- numerics
+
+def test_pad_width_invariance_through_encoder(enc, model):
+    """Padded-to-32 vs padded-to-128 logits identical: mask_bias fully
+    neutralizes pad positions end to end."""
+    cfg, params = model
+    L = enc.lengths()
+    short = [i for i in range(len(enc)) if L[i] <= 30][:BATCH]
+    b32 = enc.take(short, seq_len=32)
+    b128 = enc.take(short)
+    l32 = bert.classify(params, cfg, {k: jnp.asarray(v)
+                                      for k, v in b32.items()})
+    l128 = bert.classify(params, cfg, {k: jnp.asarray(v)
+                                       for k, v in b128.items()})
+    assert np.array_equal(np.argmax(l32, -1), np.argmax(l128, -1))
+    np.testing.assert_allclose(np.asarray(l32), np.asarray(l128),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_packed_row_matches_unpacked_examples(enc, model):
+    """Each packed segment's logits equal its example's unpacked logits:
+    block-diagonal attention + per-segment positions preserve per-example
+    math exactly (same argmax, float-tolerance logits)."""
+    cfg, params = model
+    packed = pack_classification(enc, max_segments=8)
+    pb = {k: jnp.asarray(v) for k, v in packed.arrays.items()}
+    lp = np.asarray(bert.classify(params, cfg, pb))        # [N, M, C]
+    lu = np.asarray(bert.classify(
+        params, cfg, {k: jnp.asarray(v) for k, v in enc.arrays.items()
+                      if k != "label"}))                    # [n, C]
+    # recover each segment's source example by matching its token slice
+    w = packed.arrays["example_weight"] > 0
+    seg_ids = packed.arrays["segment_ids"]
+    ii = packed.arrays["input_ids"]
+    L = enc.lengths()
+    src_ids = enc.arrays["input_ids"]
+    checked = 0
+    for r in range(packed.n):
+        for s_ in range(packed.max_segments):
+            if not w[r, s_]:
+                continue
+            seg_tok = ii[r][seg_ids[r] == s_ + 1]
+            matches = [i for i in range(len(enc))
+                       if L[i] == len(seg_tok)
+                       and np.array_equal(src_ids[i, :L[i]], seg_tok)]
+            assert matches
+            np.testing.assert_allclose(
+                lp[r, s_], lu[matches[0]], rtol=1e-4, atol=1e-4)
+            assert np.argmax(lp[r, s_]) == np.argmax(lu[matches[0]])
+            checked += 1
+    assert checked == len(enc)
+
+
+# ------------------------------------------------- loader + pipeline parity
+
+@pytest.fixture(scope="module")
+def train_setup(tok):
+    args = Args(model="bert-tiny", max_seq_len=S, train_batch_size=BATCH,
+                dropout=0.0, attn_dropout=0.0, learning_rate=1e-3,
+                fuse_steps=3)
+    cfg = get_config("bert-tiny", vocab_size=tok.vocab_size, num_labels=6,
+                     dropout=0.0, attn_dropout=0.0)
+    tx = build_optimizer(None, args)
+    state0 = init_state(jax.random.key(0), cfg, tx, rng=jax.random.key(1))
+    return args, cfg, tx, state0
+
+
+@pytest.mark.parametrize("mode", ["bucket", "pack"])
+def test_resident_pipeline_bitwise_matches_sync(mode, corpus, tok, enc,
+                                                train_setup):
+    args, cfg, tx, state0 = train_setup
+    args = args.replace(length_mode=mode)
+    col = Collator(tok, S)
+    step = make_train_step(cfg, tx, args)
+    multi = make_multi_step(cfg, tx, args)
+    put = lambda b: {k: jnp.asarray(v) for k, v in b.items()}  # noqa: E731
+    losses = {}
+    for pipe_mode in ("sync", "resident"):
+        loader = build_length_train_loader(args, corpus, col, enc,
+                                           batch_size=BATCH)
+        pipe = build_pipeline(args.replace(pipeline=pipe_mode), loader,
+                              put=put)
+        st = jax.tree_util.tree_map(jnp.copy, state0)
+        out = []
+        for epoch in range(2):
+            pipe.set_epoch(epoch)
+            for batch, n, fused, _ex in pipe.macro_batches(args.fuse_steps):
+                if fused:
+                    st, m = multi(st, batch)
+                    out.extend(np.asarray(m["loss"]).tolist())
+                else:
+                    st, m = step(st, batch)
+                    out.append(float(m["loss"]))
+        losses[pipe_mode] = out
+        if pipe_mode == "resident":
+            assert pipe.stats.snapshot()["bytes_uploaded_in_loop"] == 0
+    assert losses["sync"] == losses["resident"]
+
+
+def test_bucket_mode_transport_reports_per_bucket_waste(corpus, tok, enc,
+                                                        train_setup):
+    args, cfg, tx, state0 = train_setup
+    args = args.replace(length_mode="bucket")
+    loader = build_length_train_loader(args, corpus, Collator(tok, S),
+                                       enc, batch_size=BATCH)
+    pipe = build_pipeline(args.replace(pipeline="sync"), loader,
+                          put=lambda b: b)
+    for _ in pipe.macro_batches(1):
+        pass
+    snap = pipe.stats.snapshot()
+    assert set(snap["by_bucket"]) == {"32", "64", "128"}
+    full_width = EncodedDataset(corpus, tok, S)
+    # bucketing strictly reduces token waste vs padding everything to S
+    full_waste = 1.0 - full_width.arrays["attention_mask"].sum() / (
+        len(corpus) * S)
+    assert snap["padding_waste_tokens"] < full_waste
+    # per-bucket entries are internally consistent
+    for b in snap["by_bucket"].values():
+        assert 0 <= b["tokens_real"] <= b["tokens"]
+
+
+def test_loader_refuses_shard_local_drop_last_with_batching_sampler(
+        corpus, tok, enc):
+    """The sampler owns global chunking: loader-level drop_last would drop
+    by SHARD-LOCAL chunk length (a 15-row global tail = 8 rows on shard 0,
+    7 on shard 1) and desync SPMD step counts — refused loudly."""
+    sampler = LengthGroupedSampler(enc.lengths(), batch_size=4,
+                                   buckets=parse_buckets("32,64,128", S))
+    with pytest.raises(ValueError, match="sampler"):
+        DataLoader(corpus, Collator(tok, S), 4, sampler=sampler,
+                   drop_last=True, prefetch=0)
+    # set on the SAMPLER it works, globally: both shards drop the same
+    # tail batches and agree on the step count
+    shards = [LengthGroupedSampler(enc.lengths(), batch_size=4,
+                                   buckets=parse_buckets("32,64,128", S),
+                                   num_shards=2, shard_id=i, drop_last=True)
+              for i in range(2)]
+    seqs = [list(s.chunks()) for s in shards]
+    assert len(seqs[0]) == len(seqs[1]) == shards[0].batches_per_epoch
+    assert all(len(c) == 4 for sq in seqs for c, _ in sq)
+
+
+def test_accelerator_prepare_rescales_length_grouped_sampler(corpus, tok,
+                                                             enc):
+    """Accel.prepare on a bucket-mode loader rebuilds the length-grouped
+    sampler at the scaled batch: the chunk size must match the re-batched
+    loader, or take(pad_to=batch*mult) fills (mult-1)/mult of every batch
+    with zero-weight filler — a silent mult× throughput loss."""
+    from pdnlp_tpu.train.accel import Accelerator
+
+    args = Args(length_mode="bucket", train_batch_size=4)
+    loader = build_length_train_loader(args, corpus, Collator(tok, S), enc,
+                                       batch_size=4)
+    acc = Accelerator()
+    state = {"params": {"w": np.zeros((4,), np.float32)}}
+    _, prepared = acc.prepare(state, loader)
+    scaled = prepared._loader
+    assert isinstance(scaled.sampler, LengthGroupedSampler)
+    assert scaled.sampler.batch_size == 4 * acc.batch_mult
+    assert scaled.sampler.buckets == loader.sampler.buckets
+    # full (non-tail) batches carry full real rows, not 1/mult
+    weights = [b["example_weight"] for b in scaled]
+    assert max(int((w > 0).sum()) for w in weights) == 4 * acc.batch_mult
+
+
+def test_phase_table_orders_buckets_numerically():
+    """by_bucket sorts widths by VALUE: 16 < 32 < 128 (a string sort would
+    read 128 < 16 and misorder the end-of-train table)."""
+    from pdnlp_tpu.obs.phases import StepBreakdown
+
+    bd = StepBreakdown()
+    for bucket in (128, 16, 32):
+        bd.feed({"name": "step_dispatch", "t0": 0.0, "dur": 0.01, "tid": 0,
+                 "depth": 0})
+        bd.feed({"name": "device_block", "t0": 0.01, "dur": 0.001, "tid": 0,
+                 "depth": 0, "attrs": {"bucket": bucket}})
+    bd.close()
+    assert list(bd.summary()["by_bucket"]) == ["16", "32", "128"]
+
+
+def test_eval_step_handles_packed_batches(enc, train_setup):
+    args, cfg, tx, state0 = train_setup
+    packed = pack_classification(enc, max_segments=8)
+    ev = make_eval_step(cfg, args)
+    batch = packed.take(list(range(4)), pad_to=4)
+    m = ev(state0["params"], {k: jnp.asarray(v) for k, v in batch.items()})
+    real = int((batch["example_weight"] > 0).sum())
+    assert float(m["weight"]) == real
+    assert m["pred"].shape == (4 * packed.max_segments,)

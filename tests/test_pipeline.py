@@ -386,7 +386,7 @@ def test_macro_stage_disables_on_aliased_upload(corpus, tok):
                 np.testing.assert_array_equal(held, snapshot)
             prev = (batch["input_ids"], batch["input_ids"].copy())
     assert not stage.enabled
-    assert stage._bufs is None         # staging memory released
+    assert not stage._bufs             # staging memory released
 
 
 def test_trainer_classic_path_still_macro_stacks(corpus, tok, tmp_path):
