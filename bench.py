@@ -175,6 +175,14 @@ def serve_smoke(argv) -> None:
         "checkpoint": engine.checkpoint_path,
         "model": args.model,
         "dtype": args.dtype,
+        # what the engine actually serves: the forward precision label
+        # ("int8" under --serve_dtype int8) and the routed attention impl
+        # (headline at max_seq_len; per-bucket routing alongside — sub-128
+        # buckets fall back to XLA under a pallas request)
+        "serve_dtype": engine.dtype_label,
+        "attn_impl": engine.attn_impl,
+        "attn_impl_by_seq": {str(s): i for s, i
+                             in sorted(engine.attn_impl_by_seq.items())},
         "devices": jax.device_count(),
         "platform": jax.devices()[0].platform,
         "metrics": snap,
@@ -755,6 +763,360 @@ def trace_smoke(argv) -> None:
                  f"steps/s (tolerance {tolerance}%) — see {out_path}")
 
 
+def kernel_smoke(argv) -> None:
+    """``--kernels``: kernel-path parity + A/B smoke.
+
+    Four gated blocks, written to ``results/kernel_smoke.json`` (override
+    ``--kernels_out``), non-zero exit on any violation:
+
+    1. **flash-attention parity** — pallas fwd/bwd vs XLA (dense mask AND
+       segment-native packed mask), max |Δ| gated at fp32 tolerance;
+    2. **no-HBM-bias proof** — the jaxpr of a packed ``bert.classify`` is
+       walked recursively: under ``attn_impl=pallas`` NO equation may
+       produce the [B, 1, S, S] ``segment_bias`` tensor (the XLA route
+       must, as the sanity control) — materialization is checked
+       structurally, not inferred from timings;
+    3. **fused-CE parity** — kernel (loss, correct, objective) + grads vs
+       the unfused logits path, and a full train step ``--fused_ce
+       pallas`` vs ``xla`` at loss parity;
+    4. **int8 serving** — a short seeded training run produces a real
+       checkpoint; a bf16 and an int8 engine (the int8 one loading a
+       ``quantize_ckpt``-style artifact) score the same dev set at
+       dev-accuracy parity (``--kernels_tolerance``), zero post-warmup
+       retraces each, with serve-forward throughput and the weight-bytes
+       ratio recorded.  The >=1.5x int8 throughput gate applies on TPU,
+       where the forward is weight-bound; on CPU the measured ratio is
+       recorded (XLA CPU reads fp32-converted weights either way — there
+       is no traffic to halve) and the gate is the parity set.
+
+    Timings on a CPU host run the pallas kernels in INTERPRET mode (the
+    ``pallas_interpreted`` flag in the JSON): numerics are identical to
+    compiled Mosaic, speed is not — speedup columns are only meaningful
+    from a TPU run, and the JSON says which kind produced it.
+    """
+    import random
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pdnlp_tpu.data import Collator, DataLoader, WordPieceTokenizer, build_vocab
+    from pdnlp_tpu.data.collate import EncodedDataset
+    from pdnlp_tpu.data.packing import segment_bias
+    from pdnlp_tpu.data.sampler import DistributedShardSampler
+    from pdnlp_tpu.models import bert, get_config
+    from pdnlp_tpu.ops.attention import (
+        dot_product_attention, mask_bias, resolve_impl, routed_impl,
+    )
+    from pdnlp_tpu.ops.fused_ce import fused_weighted_ce
+    from pdnlp_tpu.serve import InferenceEngine
+    from pdnlp_tpu.serve.offline import score_texts
+    from pdnlp_tpu.serve.quant import quantize_params
+    from pdnlp_tpu.train import checkpoint as ckpt_mod
+    from pdnlp_tpu.train.steps import weighted_ce
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, out_path = pop_cli_flag(
+        argv, "--kernels_out", os.path.join("results", "kernel_smoke.json"))
+    argv, epochs = pop_cli_flag(argv, "--kernels_epochs", 5, int)
+    argv, tolerance = pop_cli_flag(argv, "--kernels_tolerance", 0.08, float)
+    args = parse_cli(argv, base=Args(
+        model="bert-tiny", max_seq_len=128, train_batch_size=16,
+        learning_rate=1e-3, dropout=0.0, attn_dropout=0.0,
+        log_every=10 ** 9))
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    failures = []
+
+    def timeit_ms(fn, *a, reps=5):
+        out = fn(*a)
+        jax.block_until_ready(out)
+        t0 = time.monotonic()
+        for _ in range(reps):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        return (time.monotonic() - t0) / reps * 1e3
+
+    # ---- 1. flash-attention parity (fwd + bwd), dense and segmented ----
+    r = np.random.RandomState(args.seed)
+    B, S, N, D = 2, args.max_seq_len, 4, 32
+    q, k, v = (jnp.asarray(r.randn(B, S, N, D), jnp.float32)
+               for _ in range(3))
+    mask = jnp.asarray((r.rand(B, S) > 0.2).astype(np.int32)).at[:, 0].set(1)
+    bias = mask_bias(mask)
+    seg = np.zeros((B, S), np.int32)
+    for b in range(B):
+        pos = 0
+        for sid in range(1, 5):
+            ln = r.randint(8, S // 3)
+            seg[b, pos:pos + ln] = sid
+            pos += ln
+            if pos >= S:
+                break
+    segj = jnp.asarray(seg)
+    seg_bias = jnp.asarray(segment_bias(seg))
+
+    def attn_loss(impl, seg_route):
+        def f(q, k, v):
+            if seg_route:
+                o = dot_product_attention(
+                    q, k, v, impl=impl,
+                    segment_ids=segj if impl == "pallas" else None,
+                    bias=None if impl == "pallas" else seg_bias)
+            else:
+                o = dot_product_attention(q, k, v, bias, impl=impl)
+            return (o.astype(jnp.float32) ** 2).sum()
+        return f
+
+    parity, attn_ms = {}, {}
+    for label, seg_route in (("dense", False), ("packed", True)):
+        outs, grads = {}, {}
+        for impl in ("xla", "pallas"):
+            fn = jax.jit(jax.value_and_grad(attn_loss(impl, seg_route),
+                                            argnums=(0, 1, 2)))
+            (val, g) = fn(q, k, v)
+            outs[impl], grads[impl] = val, g
+            attn_ms[f"attn_{label}_{impl}"] = round(
+                timeit_ms(fn, q, k, v, reps=3 if impl == "pallas"
+                          and not on_tpu else 5), 3)
+        fwd_d = abs(float(outs["pallas"]) - float(outs["xla"])) \
+            / max(abs(float(outs["xla"])), 1.0)
+        bwd_d = max(float(jnp.abs(a - b).max())
+                    for a, b in zip(grads["xla"], grads["pallas"]))
+        parity[f"attn_{label}"] = {"fwd_rel": round(fwd_d, 9),
+                                   "bwd_max_abs": round(bwd_d, 9)}
+        if fwd_d > 1e-5 or bwd_d > 5e-4:
+            failures.append(f"attention {label} parity: fwd_rel={fwd_d:g} "
+                            f"bwd_max={bwd_d:g}")
+
+    # ---- 2. structural no-HBM-bias proof on the packed classify --------
+    cfg_t = get_config("bert-tiny", vocab_size=120).replace(max_position=S)
+    params_t = bert.init_params(jax.random.key(0), cfg_t)
+    M = 4
+    cls = np.zeros((B, M), np.int64)
+    for b in range(B):
+        for mseg in range(1, M + 1):
+            idx = np.flatnonzero(seg[b] == mseg)
+            cls[b, mseg - 1] = idx[0] if idx.size else 0
+    pbatch = {
+        "input_ids": jnp.asarray(r.randint(0, 120, (B, S)), jnp.int32),
+        "token_type_ids": jnp.zeros((B, S), jnp.int32),
+        "attention_mask": jnp.asarray((seg > 0).astype(np.int32)),
+        "segment_ids": segj,
+        "cls_positions": jnp.asarray(cls, jnp.int32),
+        "label": jnp.zeros((B, M), jnp.int32),
+        "example_weight": jnp.ones((B, M), jnp.float32),
+    }
+
+    def shapes_in(jaxpr, acc):
+        for eqn in jaxpr.eqns:
+            for ov in eqn.outvars:
+                aval = getattr(ov, "aval", None)
+                if aval is not None and getattr(aval, "shape", None):
+                    acc.add(tuple(aval.shape))
+            for p in eqn.params.values():
+                for sub in (p if isinstance(p, (list, tuple)) else [p]):
+                    inner = getattr(sub, "jaxpr", None)
+                    if inner is not None:
+                        shapes_in(inner, acc)
+        return acc
+
+    bias_shape = (B, 1, S, S)
+    materialized = {}
+    for impl in ("pallas", "xla"):
+        jx = jax.make_jaxpr(
+            lambda p, bt: bert.classify(p, cfg_t, bt, attn_impl=impl)
+        )(params_t, pbatch)
+        materialized[impl] = bias_shape in shapes_in(jx.jaxpr, set())
+    if materialized["pallas"]:
+        failures.append("packed pallas route materializes the "
+                        f"{bias_shape} segment_bias in its jaxpr")
+    if not materialized["xla"]:
+        failures.append("sanity: the XLA fallback no longer materializes "
+                        "segment_bias — the structural check lost its "
+                        "control")
+
+    # ---- 3. fused-CE parity + train-step A/B ---------------------------
+    T, H, C = 96, 64, args.num_labels
+    f32 = jnp.asarray(r.randn(T, H), jnp.float32)
+    W = jnp.asarray(r.randn(H, C) * 0.1, jnp.float32)
+    bW = jnp.asarray(r.randn(C) * 0.1, jnp.float32)
+    lab = jnp.asarray(r.randint(0, C, T))
+    wts = jnp.asarray((r.rand(T) > 0.2).astype(np.float32))
+
+    def ce_obj(fused):
+        def f(f32, W, bW):
+            if fused:
+                return fused_weighted_ce(f32, W, bW, lab, wts,
+                                         smoothing=0.1)[2]
+            return weighted_ce(f32 @ W + bW, lab, wts, smoothing=0.1)[2]
+        return f
+
+    ce_ms, ce_out = {}, {}
+    for mode, fused in (("xla", False), ("pallas", True)):
+        fn = jax.jit(jax.value_and_grad(ce_obj(fused), argnums=(0, 1, 2)))
+        ce_out[mode] = fn(f32, W, bW)
+        ce_ms[f"fused_ce_{mode}"] = round(timeit_ms(fn, f32, W, bW), 3)
+    ce_val = abs(float(ce_out["pallas"][0]) - float(ce_out["xla"][0]))
+    ce_grad = max(float(jnp.abs(a - b).max())
+                  for a, b in zip(ce_out["xla"][1], ce_out["pallas"][1]))
+    parity["fused_ce"] = {"value_abs": round(ce_val, 9),
+                          "grad_max_abs": round(ce_grad, 9)}
+    if ce_val > 1e-5 or ce_grad > 1e-4:
+        failures.append(f"fused-CE parity: value={ce_val:g} "
+                        f"grad_max={ce_grad:g}")
+
+    # ---- 4. train a real checkpoint, then serve bf16 vs int8 -----------
+    chars = "天地人你我他好坏大小上下来去爱恨喜怒哀乐高兴悲伤讨厌愤怒"
+    rng = random.Random(args.seed)
+
+    def synth(n):
+        out = []
+        for _ in range(n):
+            ln = rng.randint(4, 24) if rng.random() < 0.8 \
+                else rng.randint(25, 100)
+            text = "".join(rng.choice(chars) for _ in range(ln))
+            out.append((text, chars.index(text[0]) % args.num_labels))
+        return out
+
+    train_data, dev_data = synth(1024), synth(256)
+    tok = WordPieceTokenizer(build_vocab((t for t, _ in train_data),
+                                         size=256))
+    mesh, cfg, tx, state0, sh, step, put = _smoke_model(args, tok.vocab_size)
+    loader = DataLoader(
+        train_data, Collator(tok, args.max_seq_len), args.train_batch_size,
+        sampler=DistributedShardSampler(len(train_data), shuffle=True,
+                                        seed=args.seed),
+        encoded=EncodedDataset(train_data, tok, args.max_seq_len))
+    state = state0
+    for _ in range(epochs):
+        # a one-shot seeded smoke train, outside every timed window; the
+        # pipeline subsystem is not under test here
+        for batch in loader:
+            # jaxlint: disable=R7 — untimed checkpoint-producing loop
+            state, m = step(state, put(batch))
+    float(jax.device_get(m["loss"]))
+    host_params = jax.device_get(state["params"])
+    os.makedirs(args.output_dir, exist_ok=True)
+    fpath = os.path.join(args.output_dir, "kernel-smoke-cls.msgpack")
+    ckpt_mod.save_params(fpath, {"params": host_params})
+    # the offline artifact (scripts/quantize_ckpt.py math, same module)
+    from flax import serialization
+
+    qpath = os.path.join(args.output_dir, "kernel-smoke-cls.int8.msgpack")
+    with open(qpath, "wb") as fh:
+        fh.write(serialization.to_bytes(quantize_params(host_params)))
+
+    dev_texts = [t for t, _ in dev_data]
+    dev_labels = np.asarray([y for _, y in dev_data])
+    serve_rows, serve = {}, []
+    fixed_ids = [[2] + list(r.randint(5, tok.vocab_size - 1,
+                                      r.randint(3, 30))) + [3]
+                 for _ in range(64)]
+    for mode, path in (("bf16", fpath), ("int8", qpath)):
+        eng = InferenceEngine(args.replace(serve_dtype=mode),
+                              tokenizer=tok, mesh=mesh)
+        eng.load_checkpoint(path)
+        preds, _ = score_texts(eng, dev_texts, buckets=(32, 64, 128),
+                               batch_size=16)
+        acc = float((np.asarray(preds) == dev_labels).mean())
+        eng.infer_ids(fixed_ids, args.max_seq_len)  # warm the fixed shape
+        warm_retraces = eng.metrics.retraces.value
+        fwd_ms = timeit_ms(lambda: eng.infer_ids(fixed_ids,
+                                                 args.max_seq_len), reps=10)
+        retraces = eng.metrics.retraces.value - warm_retraces
+        serve_rows[mode] = {"dev_accuracy": round(acc, 4),
+                            "forward_ms_batch64": round(fwd_ms, 3),
+                            "rows_per_sec": round(64 / (fwd_ms / 1e3), 1),
+                            "retraces_post_warmup": retraces,
+                            # the timed forward runs at max_seq_len; the
+                            # bucketed accuracy pass routes per width
+                            "attn_impl": eng.routed_attn(args.max_seq_len),
+                            "attn_impl_by_seq": {
+                                str(s): i for s, i
+                                in sorted(eng.attn_impl_by_seq.items())},
+                            "dtype": eng.dtype_label,
+                            "checkpoint": path}
+        serve.append(serve_rows[mode])
+        if retraces:
+            failures.append(f"serve {mode}: {retraces} post-warmup "
+                            "retraces (expected 0)")
+    acc_drift = serve_rows["int8"]["dev_accuracy"] \
+        - serve_rows["bf16"]["dev_accuracy"]
+    if acc_drift < -tolerance:
+        failures.append(f"int8 dev accuracy {serve_rows['int8']['dev_accuracy']}"
+                        f" vs bf16 {serve_rows['bf16']['dev_accuracy']} "
+                        f"(drift {acc_drift:+.4f}, tolerance {tolerance})")
+    int8_speedup = round(serve_rows["bf16"]["forward_ms_batch64"]
+                         / serve_rows["int8"]["forward_ms_batch64"], 3)
+    if on_tpu and int8_speedup < 1.5:
+        failures.append(f"int8 serve speedup {int8_speedup} < 1.5x on TPU")
+
+    # weight HBM traffic per forward: the roofline quantity int8 halves
+    def dense_bytes(tree, per_elem):
+        total = 0
+        for node in jax.tree_util.tree_leaves_with_path(tree):
+            path, leaf = node
+            if path and getattr(path[-1], "key", None) == "kernel" \
+                    and getattr(leaf, "ndim", 0) >= 2:
+                total += leaf.size * per_elem
+        return total
+
+    bytes_bf16 = dense_bytes(host_params, 2)
+    qtree = quantize_params(host_params)
+    bytes_int8 = dense_bytes(qtree, 1) + sum(
+        leaf.size * 4 for path, leaf in
+        jax.tree_util.tree_leaves_with_path(qtree)
+        if path and getattr(path[-1], "key", None) == "qscale")
+
+    result = {
+        "metric": "kernel_smoke",
+        "model": args.model,
+        "seq_len": S,
+        "devices": jax.device_count(),
+        "platform": platform,
+        "pallas_interpreted": not on_tpu,
+        "routing": {
+            # the policy table (resolve_impl), independent of this host's
+            # backend: packed batches default to the segment-native kernel
+            # on TPU; plus what THIS run actually routed
+            "auto_packed_tpu": resolve_impl("auto", segmented=True,
+                                            backend="tpu"),
+            "auto_dense_tpu": resolve_impl("auto", segmented=False,
+                                           backend="tpu"),
+            "auto_packed_here": routed_impl("auto", S, segmented=True),
+            "dropout_forces": routed_impl("pallas", S, dropout=True),
+        },
+        "segment_bias_materialized": materialized,
+        "parity": parity,
+        "timings_ms": {**attn_ms, **ce_ms},
+        "serve": serve,
+        "int8_vs_bf16": {
+            "dev_accuracy_drift": round(acc_drift, 4),
+            "accuracy_tolerance": tolerance,
+            "forward_speedup": int8_speedup,
+            "speedup_gate": "enforced >=1.5x on tpu; recorded on cpu "
+                            "(weight traffic is the TPU-side bound)",
+            "weight_bytes_bf16": bytes_bf16,
+            "weight_bytes_int8": bytes_int8,
+            "weight_bytes_ratio": round(bytes_bf16 / bytes_int8, 3),
+        },
+        "train": {"epochs": epochs, "examples": epochs * len(train_data),
+                  "final_loss": round(float(jax.device_get(m["loss"])), 4)},
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, out_path)
+    print(json.dumps(result))
+    if failures:
+        sys.exit("kernel smoke FAILED:\n  - " + "\n  - ".join(failures)
+                 + f"\nsee {out_path}")
+
+
 def main() -> None:
     argv = sys.argv[1:]
     if "--trace" in argv:
@@ -784,6 +1146,11 @@ def main() -> None:
 
         argv, modes_arg = pop_cli_flag(argv, "--length", "all")
         return length_smoke(argv, modes_arg)
+    if "--kernels" in argv:
+        # kernel-path smoke intercept (parity + A/B, results/
+        # kernel_smoke.json) — like --pipeline/--length, not an Args knob
+        argv.remove("--kernels")
+        return kernel_smoke(argv)
     if "--serve" in argv:
         # No pretrain-cache key to fold a leaked PDNLP_GELU_TANH into here:
         # serving would silently run tanh forwards over an erf-trained
@@ -1023,6 +1390,11 @@ def main() -> None:
         "devices": jax.device_count(),
         "platform": jax.devices()[0].platform,
         "dtype": args.dtype,
+        # the attention impl the hot loop actually routed to
+        # (ops.attention.routed_impl — same decision the traced step and
+        # the step_dispatch span attr resolve)
+        "attn_impl": trainer._routed_attn(
+            args.max_seq_len, args.length_mode == "pack"),
         "fuse_steps": args.fuse_steps,
         # input-pipeline mode + measured transport (utils.metrics
         # .TransportStats): resident mode must show 0 in-loop bytes/step

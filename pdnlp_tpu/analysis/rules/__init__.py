@@ -11,4 +11,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r5_donate,
     r6_mesh_axes,
     r7_put_in_loop,
+    r8_xla_attention,
 )

@@ -1,0 +1,118 @@
+"""R8 — attention hard-pinned to XLA inside a hot-path step builder.
+
+Since the pallas kernels became the routed default (``ops.attention``:
+``"auto"`` resolves to segment-native flash attention for packed batches
+on TPU), pinning ``impl="xla"``/``attn_impl="xla"`` inside a train/serve
+step builder silently forfeits the kernel path — the exact regression the
+pre-kernel code carried as ``args.attention_impl if ... != "auto" else
+"xla"`` at the top of every builder.  The escape hatch belongs at the CLI
+(``--attn_impl xla``), where it is visible in the run config, not buried
+in a builder where every run pays it.
+
+Heuristic, scoped to *hot-path* functions — a function whose name is
+step-builder- or step-shaped (``build_*step*``/``make_*step*``, ``*_step``,
+``step_fn``) or a serve forward (``forward``/``_forward``), including
+functions nested in them (the builder's closure IS the traced body):
+
+- a call carrying ``impl="xla"`` or ``attn_impl="xla"`` as a STRING
+  LITERAL — the hard pin;
+- an assignment to an ``*impl*`` name from a conditional expression with a
+  literal ``"xla"`` arm — the legacy auto-demotion idiom (``x if cond
+  else "xla"``), which routes every "auto" run to XLA;
+- a call resolving to ``jax.nn.dot_product_attention`` — the library XLA
+  attention, which bypasses ``ops.attention``'s routing entirely.
+
+A/B probes pass the impl as a VARIABLE (``for impl in ("xla", "pallas")``)
+and are not flagged; a deliberate pin in a builder takes an inline
+``# jaxlint: disable=R8`` with its justification.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List
+
+from pdnlp_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, dotted_name, register,
+)
+
+_HOT_NAME_RE = re.compile(
+    r"^(build|make)_\w*step\w*$|^\w*step(_fn)?$|^_?forward$")
+_IMPL_KWARGS = {"impl", "attn_impl"}
+_IMPL_NAME_RE = re.compile(r"impl")
+_LIB_ATTENTION = {"jax.nn.dot_product_attention"}
+
+
+def _is_xla_literal(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value == "xla"
+
+
+@register
+class XlaAttentionInHotPath(Rule):
+    rule_id = "R8"
+    name = "xla-attention-in-hot-path"
+    hint = ("let ops.attention route the impl: pass args.attention_impl "
+            "through (\"auto\" resolves to the pallas kernels per trace — "
+            "shape/packedness/dropout in hand); force XLA from the CLI "
+            "with --attn_impl xla, not a pin inside the builder")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        # one module-wide position set: a hot fn nested in a hot fn (the
+        # builder-returns-step idiom) is walked from both scopes — each
+        # site still reports once
+        seen: set = set()
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _HOT_NAME_RE.fullmatch(fn.name):
+                continue
+            yield from self._check_body(mod, fn, seen)
+
+    def _check_body(self, mod: ModuleInfo, fn: ast.AST,
+                    seen: set) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if mod.resolves_to(node.func, _LIB_ATTENTION):
+                    key = (node.lineno, node.col_offset)
+                    if key not in seen:
+                        seen.add(key)
+                        yield self.finding(
+                            mod, node,
+                            "jax.nn.dot_product_attention in a hot-path "
+                            "builder bypasses ops.attention's kernel "
+                            "routing — packed batches lose the "
+                            "segment-native flash path")
+                for kw in node.keywords:
+                    if kw.arg in _IMPL_KWARGS and _is_xla_literal(kw.value):
+                        key = (kw.value.lineno, kw.value.col_offset)
+                        if key not in seen:
+                            seen.add(key)
+                            yield self.finding(
+                                mod, kw.value,
+                                f"attention pinned to XLA "
+                                f"({kw.arg}=\"xla\") inside a hot-path "
+                                "builder — the pallas default never runs "
+                                "here")
+            elif isinstance(node, ast.Assign):
+                yield from self._check_demotion(mod, node, seen)
+
+    def _check_demotion(self, mod: ModuleInfo, node: ast.Assign,
+                        seen: set) -> Iterator[Finding]:
+        """``attn_impl = <x> if <cond> else "xla"`` — the legacy idiom that
+        silently demotes every "auto" run to XLA."""
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not any(_IMPL_NAME_RE.search(t) for t in targets):
+            return
+        if not isinstance(node.value, ast.IfExp):
+            return
+        for arm in (node.value.body, node.value.orelse):
+            if _is_xla_literal(arm):
+                key = (arm.lineno, arm.col_offset)
+                if key not in seen:
+                    seen.add(key)
+                    yield self.finding(
+                        mod, node,
+                        "impl assignment demotes \"auto\" to XLA in a "
+                        "hot-path builder — every default run forfeits "
+                        "the pallas kernels")
+                return

@@ -173,6 +173,13 @@ def segment_bias(segment_ids: np.ndarray, dtype=np.float32) -> np.ndarray:
     block-diagonal mask that keeps packed texts independent.  Pure
     arithmetic/broadcast ops so the same function traces under jit (jnp
     arrays) and runs on host numpy.
+
+    This is the XLA FALLBACK materialization only: the routed default
+    passes the raw ``segment_ids`` down (``models.bert`` ->
+    ``ops.attention``) and the pallas flash kernel derives the mask
+    in-VMEM from the IDs — the quadratic [B, 1, S, S] tensor never
+    reaches HBM.  ``ops.attention.dot_product_attention`` calls this only
+    when the XLA path executes; nothing upstream should.
     """
     q = segment_ids[:, :, None]
     k = segment_ids[:, None, :]

@@ -152,7 +152,25 @@ def _layer_norm(x, scale, bias, eps):
 
 
 def _dense(x, p, dtype):
+    if "qscale" in p:
+        # int8 weight-only serving (serve.quant): the per-OUTPUT-channel
+        # scale commutes through the contraction, so it multiplies the
+        # [.., out] RESULT — the int8 kernel is the only weight HBM reads,
+        # and no dequantized copy materializes
+        y = x @ p["kernel"].astype(dtype)
+        return y * p["qscale"].astype(dtype) + p["bias"].astype(dtype)
     return x @ p["kernel"].astype(dtype) + p["bias"].astype(dtype)
+
+
+def _expert_scale(p, y, dtype):
+    """int8 serving (``serve.quant``): per-output-channel scale applied to
+    an expert einsum OUTPUT ``[E, ..., out]`` — the same commute as
+    ``_dense``, which never sees the MoE expert layouts.  Identity for
+    float params."""
+    if "qscale" not in p:
+        return y
+    s = p["qscale"].astype(dtype)                      # [E, out]
+    return y * s.reshape(s.shape[0], *([1] * (y.ndim - 2)), s.shape[-1])
 
 
 def _dropout(x, rate, key):
@@ -174,9 +192,10 @@ def encode(
     deterministic: bool = True,
     rng: Optional[jax.Array] = None,
     remat: bool = False,
-    attn_impl: str = "xla",
+    attn_impl: str = "auto",
     seq_axis: Optional[str] = None,
     attn_bias: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
     position_ids: Optional[jax.Array] = None,
     unroll=True,
     with_aux: bool = False,
@@ -196,9 +215,15 @@ def encode(
     axis (``ops.ring``) — the long-context sequence-parallel path.
 
     ``attn_bias``: optional additive bias broadcastable to [B, N, S, S]
-    that *replaces* the mask-derived bias — used by the packed paths
-    (MLM pretraining and packed classification) for their block-diagonal
-    segment mask (``data.packing.segment_bias``).
+    that *replaces* the mask-derived bias (an explicit pre-built mask;
+    always the XLA-style additive contract).
+
+    ``segment_ids``: [B, S] packed-row segment IDs (0 = padding) — the
+    preferred packed-path mask input: the block-diagonal mask is ROUTED,
+    not materialized here.  A pallas-routed attention computes it inside
+    the kernel (``ops.flash``); the XLA fallback builds
+    ``data.packing.segment_bias`` inside ``ops.attention``.  Either way
+    this module never holds the [B, 1, S, S] bias.
 
     ``position_ids``: optional explicit [B, S] position-embedding indices
     (packed rows restart positions per segment); default is the row
@@ -219,11 +244,19 @@ def encode(
                    shard_offset=shard_offset, position_ids=position_ids)
 
     ring_bias = bias = None
-    if attn_bias is not None:
+    if attn_bias is not None or segment_ids is not None:
         if seq_axis is not None:
-            raise ValueError("attn_bias override is not supported on the "
-                             "sequence-parallel (ring attention) path")
-        bias = attn_bias.astype(dtype)
+            raise ValueError("attn_bias/segment_ids overrides are not "
+                             "supported on the sequence-parallel (ring "
+                             "attention) path")
+        if attn_bias is not None and segment_ids is not None:
+            raise ValueError("pass attn_bias OR segment_ids, not both — "
+                             "the packed mask rides the IDs (padding is "
+                             "segment 0), an explicit bias replaces it")
+        if attn_bias is not None:
+            bias = attn_bias.astype(dtype)
+        # segment_ids: bias stays None — the mask rides the IDs into
+        # ops.attention (in-kernel on pallas, segment_bias on XLA)
     elif seq_axis is None:
         bias = mask_bias(attention_mask, dtype)
     else:
@@ -234,7 +267,8 @@ def encode(
         params["layers"], cfg, x, li=jnp.arange(cfg.num_layers), bias=bias,
         ring_bias=ring_bias, dtype=dtype, deterministic=deterministic,
         rng=rng, remat=remat, attn_impl=attn_impl, seq_axis=seq_axis,
-        unroll=unroll, with_aux=with_aux, token_mask=attention_mask,
+        segment_ids=segment_ids, unroll=unroll, with_aux=with_aux,
+        token_mask=attention_mask,
     )
 
 
@@ -268,8 +302,9 @@ def run_layers(layers: Params, cfg: BertConfig, x: jax.Array, *,
                li: jax.Array, bias: Optional[jax.Array] = None,
                ring_bias: Optional[jax.Array] = None, dtype=jnp.float32,
                deterministic: bool = True, rng: Optional[jax.Array] = None,
-               remat: bool = False, attn_impl: str = "xla",
-               seq_axis: Optional[str] = None, unroll=True,
+               remat: bool = False, attn_impl: str = "auto",
+               seq_axis: Optional[str] = None,
+               segment_ids: Optional[jax.Array] = None, unroll=True,
                with_aux: bool = False, token_mask: Optional[jax.Array] = None):
     """Scan a stacked slice of encoder layers over ``x`` ([B, S, H]).
 
@@ -294,7 +329,9 @@ def run_layers(layers: Params, cfg: BertConfig, x: jax.Array, *,
         def heads(t):
             return t.reshape(B, S, N, D)
 
-        if _fuse_qkv():
+        if _fuse_qkv() and "qscale" not in lp["q"]:
+            # (int8 params skip the fused form: concatenating quantized
+            # kernels would drop their per-channel scales)
             # one [H, 3H] projection: x is read from HBM once instead of
             # three times and XLA tiles a single larger MXU matmul.  Params
             # stay stored as separate q/k/v trees (checkpoint + tp-sharding
@@ -322,6 +359,7 @@ def run_layers(layers: Params, cfg: BertConfig, x: jax.Array, *,
                 q, k, v, bias, impl=attn_impl,
                 dropout_rate=0.0 if deterministic else cfg.attn_dropout,
                 dropout_rng=None if deterministic else jax.random.fold_in(rng, 3 * idx + 2),
+                segment_ids=segment_ids,
             )
         attn = _dense(attn.reshape(B, S, N * D), lp["o"], dtype)
         if not deterministic:
@@ -422,11 +460,13 @@ def moe_mlp(x: jax.Array, lp: Params, cfg: BertConfig, *, dtype=jnp.float32,
         combine = jnp.einsum("bske,bsk->bse", onehot, renorm)   # [B,S,E]
         up_k, up_b = lp["up"]["kernel"], lp["up"]["bias"]    # [E,H,I],[E,I]
         down_k, down_b = lp["down"]["kernel"], lp["down"]["bias"]
-        h = jnp.einsum("bsh,ehi->ebsi", x, up_k.astype(dtype)) \
-            + up_b.astype(dtype)[:, None, None, :]
+        h = _expert_scale(lp["up"],
+                          jnp.einsum("bsh,ehi->ebsi", x, up_k.astype(dtype)),
+                          dtype) + up_b.astype(dtype)[:, None, None, :]
         h = _gelu(h, cfg.gelu)
-        y = jnp.einsum("ebsi,eih->ebsh", h, down_k.astype(dtype)) \
-            + down_b.astype(dtype)[:, None, None, :]
+        y = _expert_scale(lp["down"],
+                          jnp.einsum("ebsi,eih->ebsh", h, down_k.astype(dtype)),
+                          dtype) + down_b.astype(dtype)[:, None, None, :]
         out = jnp.einsum("ebsh,bse->bsh", y, combine.astype(dtype))
 
     # Switch load-balancing statistics (masked means: see docstring)
@@ -486,11 +526,15 @@ def _moe_grouped(x: jax.Array, lp: Params, top_idx: jax.Array,
         w_flat, mode="drop")
 
     xe = jnp.concatenate([x2, jnp.zeros((1, H), x2.dtype)])[slot_tok]
-    h = jnp.einsum("ech,ehi->eci", xe, lp["up"]["kernel"].astype(dtype)) \
-        + lp["up"]["bias"].astype(dtype)[:, None, :]
+    h = _expert_scale(
+        lp["up"],
+        jnp.einsum("ech,ehi->eci", xe, lp["up"]["kernel"].astype(dtype)),
+        dtype) + lp["up"]["bias"].astype(dtype)[:, None, :]
     h = _gelu(h, cfg.gelu)
-    y = jnp.einsum("eci,eih->ech", h, lp["down"]["kernel"].astype(dtype)) \
-        + lp["down"]["bias"].astype(dtype)[:, None, :]
+    y = _expert_scale(
+        lp["down"],
+        jnp.einsum("eci,eih->ech", h, lp["down"]["kernel"].astype(dtype)),
+        dtype) + lp["down"]["bias"].astype(dtype)[:, None, :]
     y = y * slot_w[..., None].astype(dtype)           # sentinel slots -> 0
     out = jnp.zeros((T + 1, H), dtype).at[slot_tok.reshape(-1)].add(
         y.reshape(E * C, H), mode="drop")[:T]
@@ -535,10 +579,11 @@ def classify(
     deterministic: bool = True,
     rng: Optional[jax.Array] = None,
     remat: bool = False,
-    attn_impl: str = "xla",
+    attn_impl: str = "auto",
     seq_axis: Optional[str] = None,
     unroll=True,
     return_aux: bool = False,
+    return_pooled: bool = False,
 ) -> jax.Array:
     """Logits [B, num_labels] (fp32) — the ``model(**batch) -> logits`` twin
     of the reference's classification forward (``single-gpu-cls.py:119-124``:
@@ -553,13 +598,21 @@ def classify(
 
     A PACKED batch (``--length_mode pack``: ``segment_ids`` +
     ``cls_positions`` channels, ``data.packing.PackedClassificationDataset``)
-    carries several examples per row: attention gets the block-diagonal
-    ``segment_bias`` so examples never cross-attend, each segment's [CLS]
-    hidden state is gathered at its ``cls_positions`` offset, and the head
-    returns per-SEGMENT logits ``[B, M, num_labels]`` (labels/weights in
-    the batch are ``[B, M]`` to match) — per-example semantics, packed
-    compute.  The batch-key check is trace-static (dict structure, not
-    values): packed and unpacked batches are separate compiled programs."""
+    carries several examples per row: attention applies the block-diagonal
+    segment mask so examples never cross-attend (in-kernel from
+    ``segment_ids`` on the pallas route; ``data.packing.segment_bias``
+    built inside ``ops.attention`` on the XLA fallback — this function
+    never materializes it), each segment's [CLS] hidden state is gathered
+    at its ``cls_positions`` offset, and the head returns per-SEGMENT
+    logits ``[B, M, num_labels]`` (labels/weights in the batch are
+    ``[B, M]`` to match) — per-example semantics, packed compute.  The
+    batch-key check is trace-static (dict structure, not values): packed
+    and unpacked batches are separate compiled programs.
+
+    ``return_pooled``: return the pooled PRE-classifier features
+    ([B, H] / packed [B, M, H], tanh + dropout applied) instead of logits
+    — the input contract of the fused projection+CE kernel
+    (``ops.fused_ce``), which consumes the classifier weights itself."""
     packed = "cls_positions" in batch
     if packed and seq_axis is not None:
         raise ValueError("packed classification rows are not supported on "
@@ -569,35 +622,44 @@ def classify(
         rng, enc_rng, drop_rng = jax.random.split(rng, 3)
     else:
         enc_rng = drop_rng = None
-    attn_bias = None
-    if packed:
-        from pdnlp_tpu.data.packing import segment_bias
-
-        attn_bias = segment_bias(batch["segment_ids"], dtype=jnp.float32)
     hidden, aux = encode(
         params, cfg,
         batch["input_ids"], batch["token_type_ids"], batch["attention_mask"],
         dtype=dtype, deterministic=deterministic, rng=enc_rng, remat=remat,
-        attn_impl=attn_impl, seq_axis=seq_axis, attn_bias=attn_bias,
+        attn_impl=attn_impl, seq_axis=seq_axis,
+        segment_ids=batch["segment_ids"] if packed else None,
         position_ids=batch.get("position_ids") if packed else None,
         unroll=unroll, with_aux=True,
     )
+    head = pooled_features if return_pooled else pooled_logits
     if packed:
         # per-segment pooled-output gather: [B, S, H] at [B, M] offsets
         pos = batch["cls_positions"].astype(jnp.int32)
         hM = jnp.take_along_axis(hidden, pos[..., None], axis=1)  # [B, M, H]
         B, M, H = hM.shape
-        logits = pooled_logits(params, cfg, hM.reshape(B * M, H), dtype=dtype,
-                               drop_rng=None if deterministic else drop_rng)
-        logits = logits.reshape(B, M, -1)
-        return (logits, aux) if return_aux else logits
+        out = head(params, cfg, hM.reshape(B * M, H), dtype=dtype,
+                   drop_rng=None if deterministic else drop_rng)
+        out = out.reshape(B, M, -1)
+        return (out, aux) if return_aux else out
     h0 = hidden[:, 0, :]
     if seq_axis is not None:
         on_shard0 = (jax.lax.axis_index(seq_axis) == 0).astype(h0.dtype)
         h0 = jax.lax.psum(h0 * on_shard0, seq_axis)
-    logits = pooled_logits(params, cfg, h0, dtype=dtype,
-                           drop_rng=None if deterministic else drop_rng)
-    return (logits, aux) if return_aux else logits
+    out = head(params, cfg, h0, dtype=dtype,
+               drop_rng=None if deterministic else drop_rng)
+    return (out, aux) if return_aux else out
+
+
+def pooled_features(params: Params, cfg: BertConfig, h0: jax.Array, *,
+                    dtype=jnp.float32, drop_rng=None) -> jax.Array:
+    """[CLS] hidden rows [B, H] -> pooled pre-classifier features [B, H]
+    (tanh pooler + optional dropout) — the classifier's input, split out so
+    the fused projection+CE kernel (``ops.fused_ce``) can consume the final
+    matmul itself."""
+    pooled = jnp.tanh(_dense(h0, params["pooler"], dtype))
+    if drop_rng is not None:
+        pooled = _dropout(pooled, cfg.dropout, drop_rng)
+    return pooled
 
 
 def pooled_logits(params: Params, cfg: BertConfig, h0: jax.Array, *,
@@ -606,8 +668,6 @@ def pooled_logits(params: Params, cfg: BertConfig, h0: jax.Array, *,
     pooler, optional dropout (``drop_rng`` given), classifier.  Shared by
     ``classify`` and the pipeline-parallel path so the head cannot drift
     between them."""
-    pooled = jnp.tanh(_dense(h0, params["pooler"], dtype))
-    if drop_rng is not None:
-        pooled = _dropout(pooled, cfg.dropout, drop_rng)
+    pooled = pooled_features(params, cfg, h0, dtype=dtype, drop_rng=drop_rng)
     logits = _dense(pooled, params["classifier"], dtype)
     return logits.astype(jnp.float32)

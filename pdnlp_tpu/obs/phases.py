@@ -41,6 +41,12 @@ PHASES = ("data_wait", "h2d_put", "step_dispatch", "device_block",
 #: stream (the traced loop's per-step barrier)
 STEP_END_PHASE = "device_block"
 
+#: span attrs tallied as adoption counters (any span name, incl. the serve
+#: vocabulary): ``attn_impl`` = the routed attention kernel on a dispatch,
+#: ``dtype`` = the serve forward precision (``"int8"`` under weight-
+#: quantized serving)
+_ADOPTION_ATTRS = ("attn_impl", "dtype")
+
 
 def _bucket_key(bucket) -> tuple:
     """Numeric-aware sort for bucket labels: widths 16/32/64/128 order by
@@ -103,10 +109,22 @@ class StepBreakdown:
         # main thread's step spans without this
         self._lock = threading.Lock()
         self._children: Dict[int, List] = {}  # tid -> [(t0, t1, dur, depth)]
+        # kernel/precision adoption counters: spans carrying an
+        # ``attn_impl`` (train dispatch) or ``dtype`` (serve forward) attr
+        # are tallied by value, so ``summarize``/the end-of-train table
+        # show WHICH impl the hot path actually ran, not just how long
+        self._impls: Dict[str, Dict[str, int]] = {}
 
     # ------------------------------------------------------------- feeding
     def feed(self, record: Dict) -> None:
         name = record.get("name")
+        attrs = record.get("attrs") or {}
+        for key in _ADOPTION_ATTRS:
+            v = attrs.get(key)
+            if v is not None:
+                with self._lock:
+                    by = self._impls.setdefault(key, {})
+                    by[str(v)] = by.get(str(v), 0) + 1
         if name not in PHASES:
             return
         full = float(record.get("dur", 0.0))
@@ -201,6 +219,9 @@ class StepBreakdown:
                 "share": round(total / grand, 4),
             }
         out = {"steps": self.steps, "groups": self.groups, "phases": phases}
+        if self._impls:
+            out["impls"] = {k: dict(sorted(v.items(), key=lambda kv: -kv[1]))
+                            for k, v in sorted(self._impls.items())}
         if self._per_bucket:
             out["by_bucket"] = {
                 str(bucket): {
@@ -244,6 +265,11 @@ def format_table(summary: Dict) -> str:
             f"{s['p95_sec'] * 1e3:>10.3f} {s['share']:>6.1%}")
     lines.append(f"steps: {summary.get('steps', 0)}  "
                  f"dispatch groups: {summary.get('groups', 0)}")
+    # adoption line (kernel/precision): which impl the hot path actually
+    # ran — `attn_impl: pallas x384` is the pallas-is-default receipt
+    for key, by in summary.get("impls", {}).items():
+        lines.append(f"{key}: " + "  ".join(
+            f"{val} x{n}" for val, n in by.items()))
     # per-bucket breakdown (length-aware runs): one line per bucket x
     # phase so a bucketed run's table shows where each width's time goes
     for bucket, b in summary.get("by_bucket", {}).items():

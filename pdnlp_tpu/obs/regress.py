@@ -166,5 +166,14 @@ def diff_breakdowns(base: Dict, cand: Dict, *, threshold: float = 0.2,
             row["delta_ratio"] = None
             row["regressed"] = False
         phases[name] = row
-    return {"threshold": threshold, "phases": phases,
-            "regressions": regressions}
+    out = {"threshold": threshold, "phases": phases,
+           "regressions": regressions}
+    # kernel/precision adoption (summary "impls"): surfaced so a phase
+    # delta caused by an impl change (xla -> pallas attention, bf16 ->
+    # int8 serving) is attributable from the diff alone.  Informational —
+    # an intentional adoption change SHOULD move phase means; the exit
+    # code stays about unexplained regressions.
+    ia, ib = base.get("impls"), cand.get("impls")
+    if ia or ib:
+        out["impls"] = {"base": ia, "cand": ib, "changed": ia != ib}
+    return out

@@ -1,23 +1,42 @@
-"""Multi-head scaled-dot-product attention.
+"""Multi-head scaled-dot-product attention + the impl routing policy.
 
 The compute layout is TPU-first: batched einsums that XLA tiles straight
 onto the MXU, softmax in fp32 regardless of the compute dtype (bf16 exponent
 range is fine but the reduction wants fp32 mantissa), and an additive mask
 bias instead of boolean select so the whole score pipeline stays fused.
 
-``impl="pallas"`` selects the hand-written flash-attention kernel in
-``pdnlp_tpu.ops.flash`` when available; ``"xla"`` is the always-correct
-reference path (at seq len 128 XLA's fusion is already near-roofline, the
-pallas kernel matters for the long-context path).
+``impl`` selects the kernel:
+
+- ``"xla"`` — the always-correct reference path;
+- ``"pallas"`` — the hand-written flash-attention kernel in
+  ``pdnlp_tpu.ops.flash`` (segment-native: packed rows mask in-kernel from
+  ``segment_ids`` instead of a [B, 1, S, S] HBM bias);
+- ``"auto"`` — the measured default: pallas for SEGMENTED (packed) batches
+  on a real TPU backend, where skipping the quadratic segment-bias
+  materialization wins; XLA otherwise (``scripts/bench_attention.py``
+  measured XLA's fused attention ahead of the dense-path kernel at every
+  tested shape on v5e — README "Pallas flash attention vs XLA").
+
+Routing is resolved statically at trace time (:func:`routed_impl`); a
+*requested* pallas that cannot run (sequence not tiling the 128-wide
+kernel blocks) falls back to XLA with a once-per-process-per-shape warning
+so a misrouted hot path is visible, not silent.  Attention-probability
+dropout always forces XLA — the kernel does not implement it (documented;
+the routing tests pin it).
 """
 from __future__ import annotations
 
+import functools
+import sys
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e9  # additive mask bias; well inside bf16/f32 range
+
+#: shapes already warned about (once per process per shape, not per trace)
+_FALLBACK_WARNED: set = set()
 
 
 def mask_bias(attention_mask: jax.Array, dtype=jnp.float32) -> jax.Array:
@@ -27,14 +46,81 @@ def mask_bias(attention_mask: jax.Array, dtype=jnp.float32) -> jax.Array:
     ]
 
 
+def resolve_impl(requested: str, *, segmented: bool = False,
+                 backend: Optional[str] = None) -> str:
+    """Backend-level routing: ``"xla"``/``"pallas"`` pass through;
+    ``"auto"`` becomes pallas for segmented (packed) batches on a real TPU
+    backend and XLA everywhere else (the measured-faster choice — see the
+    module docstring).  Shape/dropout feasibility is :func:`routed_impl`.
+    ``backend`` overrides the running backend — how the bench reports the
+    TPU routing policy from a CPU host without pretending to measure it."""
+    if requested == "auto":
+        backend = backend or jax.default_backend()
+        return "pallas" if segmented and backend == "tpu" else "xla"
+    if requested not in ("xla", "pallas"):
+        raise ValueError(
+            f"attention impl must be 'auto', 'xla' or 'pallas', "
+            f"got {requested!r}")
+    return requested
+
+
+def routed_impl(requested: str, seq_len: int, *, segmented: bool = False,
+                dropout: bool = False) -> str:
+    """The impl that will actually execute for this (static) configuration
+    — the single decision :func:`dot_product_attention`, the trainer's
+    ``step_dispatch`` span attr, and the bench JSON all share, so the
+    surfaced impl can never drift from the routed one."""
+    impl = resolve_impl(requested, segmented=segmented)
+    if impl != "pallas":
+        return "xla"
+    if dropout:
+        return "xla"  # kernel has no probability dropout (documented)
+    from pdnlp_tpu.ops import flash
+
+    if not flash.supported_seq(seq_len):
+        _warn_fallback(requested, seq_len)
+        return "xla"
+    return "pallas"
+
+
+@functools.lru_cache(maxsize=None)
+def routed_impl_cached(requested: str, seq_len: int, *,
+                       segmented: bool = False,
+                       dropout: bool = False) -> str:
+    """Memoized :func:`routed_impl` for per-dispatch host-loop callers
+    (the trainer's and the serve engine's span stamping): routing is pure
+    in its hashable arguments, so the hot loop pays one dict hit — the
+    memoization lives HERE, next to the decision it wraps, not re-rolled
+    per caller.  The fallback warning stays once-per-process either way."""
+    return routed_impl(requested, seq_len, segmented=segmented,
+                       dropout=dropout)
+
+
+def _warn_fallback(requested: str, seq_len: int) -> None:
+    """Once per process per shape: a pallas-routed attention fell back to
+    XLA because the sequence length does not tile the kernel blocks."""
+    key = ("seq", seq_len)
+    if key in _FALLBACK_WARNED:
+        return
+    _FALLBACK_WARNED.add(key)
+    from pdnlp_tpu.ops import flash
+
+    print(f"[ops.attention] impl={requested!r} routed to pallas but "
+          f"seq_len={seq_len} does not tile the {flash.BLOCK_Q}-wide kernel "
+          "blocks — falling back to XLA attention for this shape "
+          "(widths from --length_buckets under 128 always take this path; "
+          "force --attn_impl xla to silence)", file=sys.stderr)
+
+
 def dot_product_attention(
     q: jax.Array,  # [B, S, N, D]
     k: jax.Array,  # [B, S, N, D]
     v: jax.Array,  # [B, S, N, D]
     bias: Optional[jax.Array] = None,  # broadcastable to [B, N, Sq, Sk]
-    impl: str = "xla",
+    impl: str = "auto",
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,  # [B, S] int, 0 = padding
 ) -> jax.Array:
     """Returns [B, S, N, D] attention output in q's dtype.
 
@@ -42,15 +128,32 @@ def dot_product_attention(
     matching HF BERT's ``attention_probs_dropout_prob``.  The pallas kernel
     does not implement probability dropout, so a training-time dropout
     request always takes the XLA path.
+
+    ``segment_ids`` carries the packed-row block-diagonal mask (attend iff
+    query and key share a nonzero segment).  On the pallas path the mask is
+    computed inside the kernel and the [B, 1, S, S] ``segment_bias`` never
+    materializes; the XLA path builds it here (the retained reference
+    fallback — ``data.packing.segment_bias``, hoisted by CSE under the
+    default fully-unrolled layer scan).
     """
+    if bias is not None and segment_ids is not None:
+        # reject on EVERY route (the pallas kernel would raise; the XLA
+        # path would silently apply only the bias and let co-packed
+        # examples cross-attend — backend-dependent correctness)
+        raise ValueError("pass bias OR segment_ids, not both — the packed "
+                         "block-diagonal mask rides the IDs, and padding "
+                         "is segment 0")
     use_dropout = dropout_rate > 0.0 and dropout_rng is not None
-    if impl == "pallas" and not use_dropout:
-        try:
-            from pdnlp_tpu.ops import flash
-        except ImportError:
-            flash = None
-        if flash is not None and flash.supported(q):
-            return flash.flash_attention(q, k, v, bias)
+    impl = routed_impl(impl, q.shape[1], segmented=segment_ids is not None,
+                       dropout=use_dropout)
+    if impl == "pallas":
+        from pdnlp_tpu.ops import flash
+
+        return flash.flash_attention(q, k, v, bias, segment_ids=segment_ids)
+    if segment_ids is not None and bias is None:
+        from pdnlp_tpu.data.packing import segment_bias
+
+        bias = segment_bias(segment_ids, dtype=jnp.float32).astype(q.dtype)
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqnd,bknd->bnqk", q, k) * scale
     if bias is not None:

@@ -7,11 +7,32 @@ streams KV blocks through an online softmax (the FlashAttention recurrence),
 so HBM traffic stays linear in S:
 
 - **forward**: grid over (batch*heads, Q blocks); fori_loop over KV blocks
-  carrying (acc, rowmax m, rowsum l); saves the logsumexp rows L for the
-  backward pass.
+  carrying (acc, rowmax m, rowsum l); saves the (m, l) rows for the
+  backward pass.  The rows are saved SEPARATELY, not folded into the usual
+  logsumexp ``L = m + log l``: a fully-masked query row (packed-row padding
+  is segment 0) puts every score at ``-1e9``, where fp32 resolution is
+  ~64 — the ``log l`` term would round away entirely and the backward's
+  recomputed probabilities would come back unnormalized.  ``exp(s - m) / l``
+  is exact there (``s - m`` is an exact 0), matching XLA's softmax VJP.
 - **backward**: two independent kernels (no cross-grid accumulation):
   dQ gridded over Q blocks, dK/dV gridded over KV blocks, both recomputing
-  probabilities from L — the standard FlashAttention-2 split.
+  probabilities from (m, l) — the standard FlashAttention-2 split.
+
+**Segment-native masking** (``segment_ids``): packed rows
+(``data.packing``) need a block-diagonal mask so co-packed examples never
+cross-attend.  The XLA path materializes it as a [B, 1, S, S] additive
+``segment_bias`` in HBM; here the mask is computed *inside the kernel* from
+per-token segment IDs held in VMEM — the [S, S] bias never exists.  The
+IDs travel in two linear-in-S layouts (the splash-attention convention, so
+no sublane<->lane relayout happens in-kernel):
+
+- k-side: ``[B, 1, S]`` int32, read as a lane row;
+- q-side: ``[B, S, LANES]`` int32 (IDs broadcast over a 128-lane minor
+  dim), read as a ``[block, 1]`` column slice.
+
+The mask is applied ADDITIVELY (0 / -1e9), bit-matching the XLA
+``segment_bias`` semantics — including on fully-padded query rows, where
+both formulations reduce to softmax of the raw scores.
 
 All matmuls run on the MXU with fp32 accumulation (``preferred_element_type``)
 regardless of the compute dtype.  Probability dropout is not implemented —
@@ -29,10 +50,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401 (TPU lowering)
 
 BLOCK_Q = 128
 BLOCK_K = 128
+LANES = 128   # minor-dim width of the q-side segment-ID layout
 NEG_INF = -1e9
 
 
@@ -42,16 +64,48 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def supported_seq(seq_len: int) -> bool:
+    """Static-shape gate: S must tile by the 128-wide kernel blocks."""
+    return seq_len >= BLOCK_Q and seq_len % BLOCK_Q == 0
+
+
 def supported(q: jax.Array) -> bool:
-    """Static-shape gate used by ``ops.attention``: S must tile by 128."""
-    S = q.shape[1]
-    return S >= BLOCK_Q and S % BLOCK_Q == 0
+    """Static-shape gate used by ``ops.attention`` (``q``: [B, S, N, D])."""
+    return supported_seq(q.shape[1])
+
+
+def _seg_inputs(segment_ids: jax.Array):
+    """[B, S] segment IDs -> (k-side [B, 1, S], q-side [B, S, LANES]).
+
+    Both are linear in S (int32), vs the quadratic [B, 1, S, S] bias the
+    XLA path materializes.  The q-side lane broadcast exists so the kernel
+    can read a [block, 1] COLUMN of IDs without a lane->sublane relayout;
+    XLA CSEs the broadcast across the (fully unrolled) layer stack, so it
+    is built once per step, not once per layer.
+    """
+    seg = segment_ids.astype(jnp.int32)
+    seg_kv = seg[:, None, :]
+    seg_q = jnp.broadcast_to(seg[:, :, None], seg.shape + (LANES,))
+    return seg_kv, seg_q
+
+
+def _seg_bias_block(qs, ks):
+    """Additive mask block from ID slices (qs: [rows, 1], ks: [1, cols]):
+    0 where query and key share a nonzero segment, -1e9 elsewhere —
+    exactly ``data.packing.segment_bias`` semantics, computed in VMEM."""
+    same = (qs == ks) & (qs > 0)
+    return jnp.where(same, 0.0, NEG_INF).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, l_ref, *, scale, s_len):
+def _fwd_kernel(*refs, scale, s_len, segmented):
+    if segmented:
+        q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref, m_ref, l_ref = refs
+        qs = sq_ref[0, :, :1]                         # [Bq, 1] int32
+    else:
+        q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref = refs
     q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
     nk = s_len // BLOCK_K
 
@@ -59,10 +113,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, l_ref, *, scale, s_len):
         acc, m, l = carry
         k = k_ref[0, pl.ds(ki * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(ki * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        b = bias_ref[0, 0, pl.ds(ki * BLOCK_K, BLOCK_K)].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s = s + b[None, :]
+        if segmented:
+            ks = skv_ref[0, 0, pl.ds(ki * BLOCK_K, BLOCK_K)][None, :]
+            s = s + _seg_bias_block(qs, ks)
+        else:
+            b = bias_ref[0, 0, pl.ds(ki * BLOCK_K, BLOCK_K)].astype(jnp.float32)
+            s = s + b[None, :]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -75,51 +133,81 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, l_ref, *, scale, s_len):
     l0 = jnp.zeros((BLOCK_Q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    l_ref[0, 0] = (m + jnp.log(l))[:, 0]              # logsumexp rows
+    # (m, l) saved separately — see module docstring: folding them into
+    # L = m + log(l) loses log(l) to fp32 rounding on fully-masked rows
+    m_ref[0, 0] = m[:, 0]
+    l_ref[0, 0] = l[:, 0]
 
 
-def _fwd(q3, k3, v3, bias2, scale):
-    """q3/k3/v3: [BN, S, D]; bias2: [BN, S] additive. -> (o3, L[BN, S])."""
+def _fwd(q3, k3, v3, mask, scale, n_heads, segmented):
+    """q3/k3/v3: [BN, S, D]; mask: [B,1,S] bias or (seg_kv, seg_q).
+    -> (o3, m[BN, 1, S], l[BN, 1, S]).  Mask operands live at batch
+    granularity and are broadcast over heads via the ``bh // n_heads``
+    index maps — no N-fold HBM copy."""
     BN, S, D = q3.shape
+    n = n_heads
     grid = (BN, S // BLOCK_Q)
-    kernel = functools.partial(_fwd_kernel, scale=scale, s_len=S)
-    o3, L = pl.pallas_call(
+    kernel = functools.partial(_fwd_kernel, scale=scale, s_len=S,
+                               segmented=segmented)
+    if segmented:
+        seg_kv, seg_q = mask
+        mask_ops = [seg_q, seg_kv]
+        mask_specs = [
+            pl.BlockSpec((1, BLOCK_Q, LANES), lambda bh, qi: (bh // n, qi, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh // n, 0, 0)),
+        ]
+    else:
+        mask_ops = [mask]
+        mask_specs = [pl.BlockSpec((1, 1, S),
+                                   lambda bh, qi: (bh // n, 0, 0))]
+    o3, m, l = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh, 0, 0)),
+            *mask_specs,
         ],
         out_specs=[
             pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
             pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BN, S, D), q3.dtype),
             jax.ShapeDtypeStruct((BN, 1, S), jnp.float32),
+            jax.ShapeDtypeStruct((BN, 1, S), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q3, k3, v3, bias2)
-    return o3, L
+    )(q3, k3, v3, *mask_ops)
+    return o3, m, l
 
 
 # --------------------------------------------------------------- backward
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, L_ref, Di_ref, dq_ref,
-               *, scale):
+def _dq_kernel(*refs, scale, segmented):
+    if segmented:
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, m_ref, l_ref,
+         Di_ref, dq_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, bias_ref, do_ref, m_ref, l_ref, Di_ref,
+         dq_ref) = refs
     q = q_ref[0].astype(jnp.float32)                   # [Bq, D]
     k = k_ref[0].astype(jnp.float32)                   # [S, D]
     v = v_ref[0].astype(jnp.float32)                   # [S, D]
     do = do_ref[0].astype(jnp.float32)                 # [Bq, D]
-    L = L_ref[0, 0][:, None]                           # [Bq, 1]
+    m = m_ref[0, 0][:, None]                           # [Bq, 1]
+    l = l_ref[0, 0][:, None]                           # [Bq, 1]
     Di = Di_ref[0, 0][:, None]                         # [Bq, 1]
     s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
-    p = jnp.exp(s - L)                                 # [Bq, S]
+    if segmented:
+        s = s + _seg_bias_block(sq_ref[0, :, :1], skv_ref[0, 0][None, :])
+    else:
+        s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+    p = jnp.exp(s - m) / l                             # [Bq, S]
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - Di)
@@ -127,18 +215,28 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, L_ref, Di_ref, dq_ref,
                  * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, L_ref, Di_ref,
-                dk_ref, dv_ref, *, scale):
+def _dkv_kernel(*refs, scale, segmented):
+    if segmented:
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, m_ref, l_ref,
+         Di_ref, dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, bias_ref, do_ref, m_ref, l_ref, Di_ref,
+         dk_ref, dv_ref) = refs
     q = q_ref[0].astype(jnp.float32)                   # [S, D]
     k = k_ref[0].astype(jnp.float32)                   # [Bk, D]
     v = v_ref[0].astype(jnp.float32)                   # [Bk, D]
     do = do_ref[0].astype(jnp.float32)                 # [S, D]
-    L = L_ref[0, 0][:, None]                           # [S, 1]
+    m = m_ref[0, 0][:, None]                           # [S, 1]
+    l = l_ref[0, 0][:, None]                           # [S, 1]
     Di = Di_ref[0, 0][:, None]                         # [S, 1]
     s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]  # bias over this K blk
-    p = jnp.exp(s - L)                                 # [S, Bk]
+    if segmented:
+        # q-side IDs over ALL S rows, k-side over this K block
+        s = s + _seg_bias_block(sq_ref[0, :, :1], skv_ref[0, 0][None, :])
+    else:
+        s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]  # this K block
+    p = jnp.exp(s - m) / l                             # [S, Bk]
     dv_ref[0] = jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(dv_ref.dtype)
@@ -150,37 +248,60 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, L_ref, Di_ref,
         preferred_element_type=jnp.float32) * scale).astype(dk_ref.dtype)
 
 
-def _bwd(scale, res, do3):
-    q3, k3, v3, bias2, o3, L = res
+def _bwd_impl(scale, n_heads, segmented, res, do3):
+    q3, k3, v3, mask, o3, m, l = res
     BN, S, D = q3.shape
-    Di = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)[:, None, :]
+    n = n_heads
+    Di = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                 axis=-1)[:, None, :]
+    if segmented:
+        seg_kv, seg_q = mask
+        # dq reads the full k-side row; dkv slices it per K block
+        dq_mask_ops = [seg_q, seg_kv]
+        dq_mask_specs = [
+            pl.BlockSpec((1, BLOCK_Q, LANES), lambda bh, qi: (bh // n, qi, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh // n, 0, 0)),
+        ]
+        dkv_mask_ops = [seg_q, seg_kv]
+        dkv_mask_specs = [
+            pl.BlockSpec((1, S, LANES), lambda bh, ki: (bh // n, 0, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K), lambda bh, ki: (bh // n, 0, ki)),
+        ]
+    else:
+        dq_mask_ops = dkv_mask_ops = [mask]
+        dq_mask_specs = [pl.BlockSpec((1, 1, S),
+                                      lambda bh, qi: (bh // n, 0, 0))]
+        dkv_mask_specs = [pl.BlockSpec((1, 1, BLOCK_K),
+                                       lambda bh, ki: (bh // n, 0, ki))]
 
     dq3 = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale),
+        functools.partial(_dq_kernel, scale=scale, segmented=segmented),
         grid=(BN, S // BLOCK_Q),
         in_specs=[
             pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh, 0, 0)),
+            *dq_mask_specs,
             pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
             pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
             pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
         ],
         out_specs=pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BN, S, D), q3.dtype),
         interpret=_interpret(),
-    )(q3, k3, v3, bias2, do3, L, Di)
+    )(q3, k3, v3, *dq_mask_ops, do3, m, l, Di)
 
     dk3, dv3 = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale),
+        functools.partial(_dkv_kernel, scale=scale, segmented=segmented),
         grid=(BN, S // BLOCK_K),
         in_specs=[
             pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, 1, BLOCK_K), lambda bh, ki: (bh, 0, ki)),
+            *dkv_mask_specs,
             pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, 1, S), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((1, 1, S), lambda bh, ki: (bh, 0, 0)),
         ],
@@ -193,21 +314,50 @@ def _bwd(scale, res, do3):
             jax.ShapeDtypeStruct((BN, S, D), v3.dtype),
         ],
         interpret=_interpret(),
-    )(q3, k3, v3, bias2, do3, L, Di)
-    return dq3, dk3, dv3, None
+    )(q3, k3, v3, *dkv_mask_ops, do3, m, l, Di)
+    return dq3, dk3, dv3
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _flash3(q3, k3, v3, bias2, scale):
-    return _fwd(q3, k3, v3, bias2, scale)[0]
+# ---------------------------------------------------- custom-VJP wrappers
 
 
-def _flash3_fwd(q3, k3, v3, bias2, scale):
-    o3, L = _fwd(q3, k3, v3, bias2, scale)
-    return o3, (q3, k3, v3, bias2, o3, L)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash3(q3, k3, v3, bias2, scale, n_heads):
+    """bias2: [B, 1, S] additive, broadcast over heads via the index map."""
+    return _fwd(q3, k3, v3, bias2, scale, n_heads, segmented=False)[0]
 
 
-_flash3.defvjp(_flash3_fwd, _bwd)
+def _flash3_fwd(q3, k3, v3, bias2, scale, n_heads):
+    o3, m, l = _fwd(q3, k3, v3, bias2, scale, n_heads, segmented=False)
+    return o3, (q3, k3, v3, bias2, o3, m, l)
+
+
+def _flash3_bwd(scale, n_heads, res, do3):
+    return _bwd_impl(scale, n_heads, False, res, do3) + (None,)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash3_seg(q3, k3, v3, seg_kv, seg_q, scale, n_heads):
+    """Segment-native variant: the block-diagonal mask is computed inside
+    the kernels from (seg_kv [B,1,S], seg_q [B,S,LANES]) int32 IDs."""
+    return _fwd(q3, k3, v3, (seg_kv, seg_q), scale, n_heads,
+                segmented=True)[0]
+
+
+def _flash3_seg_fwd(q3, k3, v3, seg_kv, seg_q, scale, n_heads):
+    o3, m, l = _fwd(q3, k3, v3, (seg_kv, seg_q), scale, n_heads,
+                    segmented=True)
+    return o3, (q3, k3, v3, (seg_kv, seg_q), o3, m, l)
+
+
+def _flash3_seg_bwd(scale, n_heads, res, do3):
+    return _bwd_impl(scale, n_heads, True, res, do3) + (None, None)
+
+
+_flash3_seg.defvjp(_flash3_seg_fwd, _flash3_seg_bwd)
 
 
 def flash_attention(
@@ -215,20 +365,33 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     bias: Optional[jax.Array] = None,  # [B, 1, 1, S] additive (mask_bias)
+    segment_ids: Optional[jax.Array] = None,  # [B, S] int, 0 = padding
 ) -> jax.Array:
     """Drop-in for the XLA path of ``ops.attention.dot_product_attention``
-    (same [B, S, N, D] layout, same additive-bias contract)."""
+    (same [B, S, N, D] layout, same additive-bias contract).
+
+    ``segment_ids`` selects the segment-native packed path: the
+    block-diagonal mask (``data.packing.segment_bias`` semantics — attend
+    iff query and key share a nonzero segment) is derived in-kernel from
+    the IDs, so the [B, 1, S, S] bias never materializes in HBM.  Mutually
+    exclusive with ``bias`` — padding is already segment 0.
+    """
     B, S, N, D = q.shape
     scale = D ** -0.5
 
     def to3(t):
         return t.transpose(0, 2, 1, 3).reshape(B * N, S, D)
 
+    if segment_ids is not None:
+        if bias is not None:
+            raise ValueError("pass bias OR segment_ids, not both — padding "
+                             "is segment 0 and needs no separate mask")
+        seg_kv, seg_q = _seg_inputs(segment_ids)
+        o3 = _flash3_seg(to3(q), to3(k), to3(v), seg_kv, seg_q, scale, N)
+        return o3.reshape(B, N, S, D).transpose(0, 2, 1, 3)
     if bias is None:
-        bias2 = jnp.zeros((B * N, 1, S), jnp.float32)
+        bias2 = jnp.zeros((B, 1, S), jnp.float32)
     else:
-        bias2 = jnp.broadcast_to(
-            bias.reshape(B, 1, S).astype(jnp.float32), (B, N, S)
-        ).reshape(B * N, 1, S)
-    o3 = _flash3(to3(q), to3(k), to3(v), bias2, scale)
+        bias2 = bias.reshape(B, 1, S).astype(jnp.float32)
+    o3 = _flash3(to3(q), to3(k), to3(v), bias2, scale, N)
     return o3.reshape(B, N, S, D).transpose(0, 2, 1, 3)

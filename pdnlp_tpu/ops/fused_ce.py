@@ -1,0 +1,246 @@
+"""Fused classifier-projection + weighted cross-entropy — Pallas kernel.
+
+The unfused tail of the train step computes ``logits = pooled @ W + b``
+([T, C] fp32 written to HBM), then ``log_softmax`` (read back, reduced,
+written), then the label gather and the weighted reduction — for the
+packed path that is a [B*M, C] fp32 round-trip per step plus the softmax's
+separate reduction passes.  This kernel consumes the pooled features and
+the classifier weights directly and emits only three per-row fp32 vectors
+(bare CE, uniform-CE smoothing term, correct indicator): logits live and
+die in VMEM.
+
+- **forward**: grid over T row blocks; per block one MXU matmul
+  ``[Bt, H] @ [H, Cp]`` (classes padded to the 128-lane width with
+  ``-1e9`` bias so padded columns carry zero probability), fp32
+  log-sum-exp, label pick via a class-iota one-hot.
+- **backward** (custom VJP): recomputes probabilities per block and emits
+  ``d(pooled)`` per block plus ``dW``/``db`` accumulated across the
+  sequential grid (zero-init on the first step, ``+=`` after — the
+  standard Pallas revisiting pattern).  ``dlogits = dce * (p - onehot)
+  + dlpu * (p - uniform)`` — exactly the transpose of the unfused math,
+  including label smoothing through the uniform term.
+
+Per-row integer operands (labels) and per-row cotangents travel
+lane-broadcast (``[T, LANES]``, read as a ``[Bt, 1]`` column slice) so the
+kernel never relayouts a lane row into a sublane column — the same layout
+convention as ``ops.flash``'s q-side segment IDs.
+
+Numerics note: the unfused path rounds logits through the compute dtype
+(bf16) before the fp32 softmax; here the matmul accumulates straight into
+fp32.  The difference is well under the parity gate's tolerance (pinned in
+``tests/test_kernels.py``) and is in the fused path's favor.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# shared kernel conventions — ONE interpret-mode gate and lane width for
+# both Pallas modules, so a policy change cannot silently diverge them
+from pdnlp_tpu.ops.flash import LANES, NEG_INF, _interpret
+
+BLOCK_T = 128   # rows per grid step
+
+
+def resolve_fused_ce(args) -> str:
+    """``--fused_ce auto|xla|pallas`` -> the executing path.  ``auto`` is
+    pallas on a real TPU backend (the kernel exists to cut the HBM tail
+    there) and the XLA reference path everywhere else — CPU runs would pay
+    the interpreter for no win."""
+    requested = getattr(args, "fused_ce", "auto") or "auto"
+    if requested == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if requested not in ("xla", "pallas"):
+        raise ValueError(
+            f"fused_ce must be 'auto', 'xla' or 'pallas', got {requested!r}")
+    return requested
+
+
+def _pad_classes(kernel: jax.Array, bias: jax.Array):
+    """Pad the class dim to the lane width: weight columns 0, bias -1e9 —
+    padded logits sit at -1e9 and contribute nothing to the softmax."""
+    H, C = kernel.shape
+    Cp = max(LANES, -(-C // LANES) * LANES)
+    wp = jnp.pad(kernel, ((0, 0), (0, Cp - C)))
+    bp = jnp.pad(bias, (0, Cp - C), constant_values=NEG_INF)
+    return wp, bp.reshape(1, Cp)
+
+
+def _lane(v: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """[T] per-row operand -> [T, LANES] lane broadcast."""
+    return jnp.broadcast_to(v.astype(dtype)[:, None], v.shape + (LANES,))
+
+
+def _fwd_kernel(f_ref, w_ref, b_ref, lab_ref, ce_ref, lpu_ref, corr_ref,
+                *, n_classes):
+    f = f_ref[...]                                     # [Bt, H]
+    w = w_ref[...]                                     # [H, Cp]
+    logits = jax.lax.dot_general(
+        f, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b_ref[...].astype(jnp.float32)
+    Bt, Cp = logits.shape
+    lab = lab_ref[:, :1]                               # [Bt, 1] int32
+    cls = jax.lax.broadcasted_iota(jnp.int32, (Bt, Cp), 1)
+    onehot = cls == lab
+    real = cls < n_classes
+    m = jnp.max(logits, axis=-1, keepdims=True)        # [Bt, 1]
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1, keepdims=True))
+    logit_lab = jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1,
+                        keepdims=True)
+    ce = lse - logit_lab                               # [Bt, 1]
+    mean_real = jnp.sum(jnp.where(real, logits, 0.0), axis=-1,
+                        keepdims=True) / n_classes
+    lpu = lse - mean_real                              # -mean(logp), smoothing
+    # exact argmax(logits) == label semantics incl. ties (argmax picks the
+    # FIRST index attaining the max — `logit_lab >= m` would count a tied
+    # label as correct where the unfused path does not)
+    first_max = jnp.min(jnp.where(logits == m, cls, Cp), axis=-1,
+                        keepdims=True)
+    corr = (first_max == lab).astype(jnp.float32)
+    ce_ref[...] = jnp.broadcast_to(ce, (Bt, LANES))
+    lpu_ref[...] = jnp.broadcast_to(lpu, (Bt, LANES))
+    corr_ref[...] = jnp.broadcast_to(corr, (Bt, LANES))
+
+
+def _bwd_kernel(f_ref, w_ref, b_ref, lab_ref, dce_ref, dlpu_ref,
+                df_ref, dw_ref, db_ref, *, n_classes):
+    f = f_ref[...]
+    w = w_ref[...]
+    logits = jax.lax.dot_general(
+        f, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b_ref[...].astype(jnp.float32)
+    Bt, Cp = logits.shape
+    lab = lab_ref[:, :1]
+    cls = jax.lax.broadcasted_iota(jnp.int32, (Bt, Cp), 1)
+    onehot = (cls == lab).astype(jnp.float32)
+    uniform = (cls < n_classes).astype(jnp.float32) / n_classes
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)         # [Bt, Cp] softmax
+    dce = dce_ref[:, :1]                               # [Bt, 1] fp32
+    dlpu = dlpu_ref[:, :1]
+    g = dce * (p - onehot) + dlpu * (p - uniform)      # dlogits, fp32
+    df_ref[...] = jax.lax.dot_general(
+        g, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(df_ref.dtype)
+    dw = jax.lax.dot_general(
+        f.astype(jnp.float32), g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [H, Cp]
+    db = jnp.sum(g, axis=0, keepdims=True)             # [1, Cp]
+    ti = pl.program_id(0)
+
+    @pl.when(ti == 0)
+    def _init():
+        dw_ref[...] = dw
+        db_ref[...] = db
+
+    @pl.when(ti > 0)
+    def _accum():
+        dw_ref[...] += dw
+        db_ref[...] += db
+
+
+def _pad_rows(a: jax.Array, tp: int) -> jax.Array:
+    return jnp.pad(a, ((0, tp - a.shape[0]),) + ((0, 0),) * (a.ndim - 1))
+
+
+@jax.custom_vjp
+def _fused_rows(feats, kernel, bias, labels):
+    return _rows_call(feats, kernel, bias, labels)
+
+
+def _rows_call(feats, kernel, bias, labels):
+    T, H = feats.shape
+    C = kernel.shape[1]
+    Tp = max(BLOCK_T, -(-T // BLOCK_T) * BLOCK_T)
+    fp = _pad_rows(feats, Tp)
+    lab = _lane(_pad_rows(labels.astype(jnp.int32), Tp), jnp.int32)
+    wp, bp = _pad_classes(kernel, bias)
+    Cp = wp.shape[1]
+    grid = (Tp // BLOCK_T,)
+    outs = pl.pallas_call(
+        functools.partial(_fwd_kernel, n_classes=C),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((BLOCK_T, H), lambda ti: (ti, 0)),
+            pl.BlockSpec((H, Cp), lambda ti: (0, 0)),
+            pl.BlockSpec((1, Cp), lambda ti: (0, 0)),
+            pl.BlockSpec((BLOCK_T, LANES), lambda ti: (ti, 0)),
+        ],
+        out_specs=[pl.BlockSpec((BLOCK_T, LANES), lambda ti: (ti, 0))] * 3,
+        out_shape=[jax.ShapeDtypeStruct((Tp, LANES), jnp.float32)] * 3,
+        interpret=_interpret(),
+    )(fp, wp, bp, lab)
+    ce, lpu, corr = (o[:T, 0] for o in outs)
+    return ce, lpu, corr
+
+
+def _fused_rows_fwd(feats, kernel, bias, labels):
+    out = _rows_call(feats, kernel, bias, labels)
+    return out, (feats, kernel, bias, labels)
+
+
+def _fused_rows_bwd(res, cts):
+    feats, kernel, bias, labels = res
+    dce, dlpu, _dcorr = cts  # correct is a metric: cotangent is zero
+    T, H = feats.shape
+    C = kernel.shape[1]
+    Tp = max(BLOCK_T, -(-T // BLOCK_T) * BLOCK_T)
+    fp = _pad_rows(feats, Tp)
+    lab = _lane(_pad_rows(labels.astype(jnp.int32), Tp), jnp.int32)
+    wp, bp = _pad_classes(kernel, bias)
+    Cp = wp.shape[1]
+    # padded rows carry zero cotangent -> zero dlogits -> no dW/db leakage
+    dce_l = _lane(_pad_rows(dce.astype(jnp.float32), Tp))
+    dlpu_l = _lane(_pad_rows(dlpu.astype(jnp.float32), Tp))
+    grid = (Tp // BLOCK_T,)
+    df, dw, db = pl.pallas_call(
+        functools.partial(_bwd_kernel, n_classes=C),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((BLOCK_T, H), lambda ti: (ti, 0)),
+            pl.BlockSpec((H, Cp), lambda ti: (0, 0)),
+            pl.BlockSpec((1, Cp), lambda ti: (0, 0)),
+            pl.BlockSpec((BLOCK_T, LANES), lambda ti: (ti, 0)),
+            pl.BlockSpec((BLOCK_T, LANES), lambda ti: (ti, 0)),
+            pl.BlockSpec((BLOCK_T, LANES), lambda ti: (ti, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((BLOCK_T, H), lambda ti: (ti, 0)),
+            pl.BlockSpec((H, Cp), lambda ti: (0, 0)),
+            pl.BlockSpec((1, Cp), lambda ti: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Tp, H), feats.dtype),
+            jax.ShapeDtypeStruct((H, Cp), jnp.float32),
+            jax.ShapeDtypeStruct((1, Cp), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(fp, wp, bp, lab, dce_l, dlpu_l)
+    return (df[:T], dw[:, :C].astype(kernel.dtype),
+            db[0, :C].astype(bias.dtype), None)
+
+
+_fused_rows.defvjp(_fused_rows_fwd, _fused_rows_bwd)
+
+
+def fused_weighted_ce(feats, kernel, bias, labels, weights,
+                      smoothing: float = 0.0):
+    """Drop-in for ``train.steps.weighted_ce`` fed by pooled features and
+    the classifier weights instead of materialized logits: returns the
+    identical ``(weighted mean bare CE, weighted correct count, training
+    objective)`` triple — the weighted reductions and the smoothing mix
+    stay in plain traced code so their semantics literally cannot drift
+    from the unfused path."""
+    ce, lpu, corr = _fused_rows(feats, kernel, bias, labels)
+    wsum = jnp.maximum(weights.sum(), 1.0)
+    loss = (ce * weights).sum() / wsum
+    objective = loss
+    if smoothing:
+        uniform = (lpu * weights).sum() / wsum
+        objective = (1.0 - smoothing) * loss + smoothing * uniform
+    correct = (corr * weights).sum()
+    return loss, correct, objective

@@ -205,7 +205,7 @@ def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
                          "weights")
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
-    attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
+    attn_impl = args.attention_impl  # ops.attention routes "auto" per trace
     compress = jnp.bfloat16 if compress_grads else None
     unroll = _unroll(args)
     smoothing = args.label_smoothing

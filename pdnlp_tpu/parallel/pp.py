@@ -237,7 +237,7 @@ def make_pp_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
     has_data = DATA_AXIS in mesh.shape
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
-    attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
+    attn_impl = args.attention_impl  # ops.attention routes "auto" per trace
     from pdnlp_tpu.train.steps import _unroll
 
     unroll = _unroll(args)
@@ -310,7 +310,7 @@ def make_pp_eval_step(cfg: BertConfig, args, mesh: Mesh, n_micro: int = 4):
     n_stages = mesh.shape[STAGE]
     has_data = DATA_AXIS in mesh.shape
     dtype = resolve_dtype(args.dtype)
-    attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
+    attn_impl = args.attention_impl  # ops.attention routes "auto" per trace
     from pdnlp_tpu.train.steps import _unroll
 
     unroll = _unroll(args)

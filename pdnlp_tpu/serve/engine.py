@@ -56,8 +56,18 @@ class InferenceEngine:
         ``tracer`` (``pdnlp_tpu.obs``): the engine emits one span per
         executed batch — ``compile`` for a first-seen ``(seq, rows)`` shape
         (the trace shows exactly when/where retraces happen), ``forward``
-        for a cache hit.  Defaults to the process-global tracer, configured
-        from ``args`` so ``serve_tpu.py --trace true`` just works.
+        for a cache hit; both carry the serve ``dtype`` (and resolved
+        ``attn_impl``) as span attrs so kernel/precision adoption is
+        visible in ``trace_tpu.py summarize``/``diff``.  Defaults to the
+        process-global tracer, configured from ``args`` so
+        ``serve_tpu.py --trace true`` just works.
+
+        ``args.serve_dtype`` picks the forward precision independently of
+        the training dtype: ``"auto"`` follows ``args.dtype`` (the legacy
+        behavior), ``"bf16"`` forces bfloat16 compute, ``"int8"`` serves
+        per-channel int8 weights with bf16 activations (``serve.quant``) —
+        ``load_checkpoint`` quantizes a float checkpoint on the fly or
+        loads a prebuilt ``scripts/quantize_ckpt.py`` artifact directly.
         """
         from pdnlp_tpu.obs.trace import configure_from_args
 
@@ -69,28 +79,52 @@ class InferenceEngine:
                               num_labels=args.num_labels, dropout=args.dropout,
                               attn_dropout=args.attn_dropout,
                               **args_overrides(args))
-        self.dtype = resolve_dtype(args.dtype)
+        self.serve_dtype = getattr(args, "serve_dtype", "auto") or "auto"
+        if self.serve_dtype not in ("auto", "bf16", "int8"):
+            raise ValueError("serve_dtype must be 'auto', 'bf16' or 'int8', "
+                             f"got {self.serve_dtype!r}")
+        if self.serve_dtype == "auto":
+            self.dtype = resolve_dtype(args.dtype)
+        else:  # int8 weights compute against bf16 activations
+            self.dtype = resolve_dtype("bfloat16")
+        # the impl the jitted forward routes to at the engine's max width
+        # (deterministic serve: no dropout) — the headline the bench JSONs
+        # report.  Routing is PER BUCKET WIDTH (sub-128 buckets fall back
+        # to XLA), so spans stamp :meth:`routed_attn` of their actual seq,
+        # never this attribute.
+        from pdnlp_tpu.ops.attention import routed_impl_cached
+
+        self._attn_requested = args.attention_impl
+        self._impl_by_seq: Dict[int, str] = {}
+        # routed directly (not via routed_attn) so _impl_by_seq records
+        # only widths actually served, never the construction-time headline
+        self.attn_impl = routed_impl_cached(self._attn_requested,
+                                            args.max_seq_len)
         self.mesh = mesh
         self.metrics = metrics or ServeMetrics()
         self.rows_multiple = int(mesh.shape.get("data", 1)) if mesh else 1
         # the template: init-shaped params every checkpoint must match
         # (predict/test sweep semantics — setup_model's init, minus the
-        # optimizer state serving never needs)
+        # optimizer state serving never needs).  int8 mode quantizes the
+        # template too, so the params' pytree STRUCTURE is identical before
+        # and after every load — checkpoint swap stays retrace-free.
         self._template = bert.init_params(jax.random.key(args.seed), self.cfg)
-        self.params = self._put(self._template)
+        # the serving-form template is also the int8 swap template — built
+        # once here, not re-quantized on every load_checkpoint
+        self._serving_template = self._serving_form(self._template)
+        self.params = self._put(self._serving_template)
         self.checkpoint_path: Optional[str] = None
         self._seen_shapes: set = set()
 
         metrics_ref = self.metrics
+        attn_impl = args.attention_impl
 
         def _forward(params, batch):
             # Python body only executes while tracing: this IS the retrace
             # counter (jax.jit replays the compiled program otherwise)
             metrics_ref.retraces.inc()
             return bert.classify(params, self.cfg, batch, dtype=self.dtype,
-                                 deterministic=True,
-                                 attn_impl="xla" if args.attention_impl == "auto"
-                                 else args.attention_impl)
+                                 deterministic=True, attn_impl=attn_impl)
 
         if mesh is not None:
             from pdnlp_tpu.parallel.sharding import batch_sharding, replicated
@@ -112,6 +146,16 @@ class InferenceEngine:
             return jax.device_put(host_params, replicated(self.mesh))
         return jax.device_put(host_params)
 
+    def _serving_form(self, host_params):
+        """Host params -> what this engine actually serves: quantized
+        (``serve.quant``) under ``--serve_dtype int8``, unchanged
+        otherwise."""
+        if self.serve_dtype != "int8":
+            return host_params
+        from pdnlp_tpu.serve.quant import quantize_params
+
+        return quantize_params(host_params)
+
     def load_checkpoint(self, path: str) -> None:
         """Swap in a strategy checkpoint (shape-validated; cache survives).
 
@@ -120,8 +164,36 @@ class InferenceEngine:
         before any device transfer, so a wrong ``--model`` fails fast with
         one file parse (``ckpt.load_raw`` exists for template-free
         inspection when the error message isn't enough).
+
+        Under ``--serve_dtype int8`` both artifact kinds load: a float
+        checkpoint is quantized on the fly (identical math to the offline
+        pass), and a ``scripts/quantize_ckpt.py`` artifact — recognized by
+        its ``qscale`` leaves — is shape-validated against the QUANTIZED
+        template and served as-is.  A quantized artifact into a float
+        engine fails loudly (it cannot be de-quantized back to the
+        training dtype losslessly; point ``--serve_dtype int8`` at it).
         """
-        self.params = self._put(ckpt.load_params(path, self._template))
+        from pdnlp_tpu.serve.quant import is_quantized, quantize_params
+
+        # ONE file read + msgpack decode: the raw tree feeds both the
+        # quantization probe and the template-validated restore
+        raw = ckpt.load_raw(path)
+        if self.serve_dtype == "int8":
+            if is_quantized(raw):
+                host = ckpt.from_restored(
+                    raw, self._serving_template, path=path)
+            else:
+                host = quantize_params(
+                    ckpt.from_restored(raw, self._template, path=path))
+        else:
+            if is_quantized(raw):
+                raise ValueError(
+                    f"checkpoint {path!r} is an int8 artifact "
+                    "(quantize_ckpt.py) but this engine serves "
+                    f"{self.serve_dtype!r} — start it with --serve_dtype "
+                    "int8, or point it at the float checkpoint")
+            host = ckpt.from_restored(raw, self._template, path=path)
+        self.params = self._put(host)
         self.checkpoint_path = path
 
     # ----------------------------------------------------------- forward
@@ -150,8 +222,12 @@ class InferenceEngine:
             fwd = {k: jax.make_array_from_process_local_data(sh, v)
                    for k, v in fwd.items()}
         # the device_get fetch inside the span IS the completion barrier:
-        # serve spans measure request-visible latency, dispatch + compute
-        with self.tracer.span(span_name, seq=int(seq), rows=int(rows)):
+        # serve spans measure request-visible latency, dispatch + compute.
+        # dtype/attn_impl attrs make int8/pallas adoption visible in
+        # trace_tpu.py summarize and the trace-diff gate.
+        with self.tracer.span(span_name, seq=int(seq), rows=int(rows),
+                              dtype=self.dtype_label,
+                              attn_impl=self.routed_attn(int(seq))):
             logits = self._jit_forward(self.params, fwd)
             out = np.asarray(jax.device_get(logits))
         return out
@@ -173,6 +249,37 @@ class InferenceEngine:
         ids = self.tokenizer.encode_ragged(texts, seq_len)
         logits = self.infer_ids(ids, seq_len)
         return np.argmax(logits, axis=-1), logits
+
+    def routed_attn(self, seq: int) -> str:
+        """The attention impl a forward at this bucket width actually
+        routes to (``ops.attention.routed_impl_cached``) — a requested
+        pallas falls back to XLA below the 128-wide kernel blocks, so
+        per-seq routing is what spans and per-bucket reporting must carry,
+        not the max-width :attr:`attn_impl`.  ``_impl_by_seq`` records the
+        widths THIS engine served (:attr:`attn_impl_by_seq`); the
+        memoization itself lives at the routing point."""
+        from pdnlp_tpu.ops.attention import routed_impl_cached
+
+        impl = routed_impl_cached(self._attn_requested, seq)
+        self._impl_by_seq.setdefault(seq, impl)
+        return impl
+
+    @property
+    def attn_impl_by_seq(self) -> Dict[int, str]:
+        """{bucket width: routed impl} for every width this engine has
+        routed so far — the honest per-bucket adoption record the bench
+        JSONs embed alongside the max-width headline."""
+        return dict(self._impl_by_seq)
+
+    @property
+    def dtype_label(self) -> str:
+        """The serving precision as a span/JSON label: ``"int8"`` for
+        weight-quantized serving, else the activation dtype name."""
+        if self.serve_dtype == "int8":
+            return "int8"
+        import numpy as _np
+
+        return _np.dtype(self.dtype).name
 
     # ------------------------------------------------------------ shapes
     def pad_rows(self, n: int) -> int:

@@ -81,9 +81,19 @@ def load(path: str, like) -> Any:
     error at the next forward pass (e.g. loading a ``bert-tiny`` checkpoint
     into a ``bert-base`` template).
     """
-    template = _wrap_rng(like) if isinstance(like, dict) and "rng" in like else like
     with open(path, "rb") as f:
-        restored = serialization.from_bytes(template, f.read())
+        raw = serialization.msgpack_restore(f.read())
+    return from_restored(raw, like, path=path)
+
+
+def from_restored(raw, like, *, path: str = "<restored>") -> Any:
+    """:func:`load`'s template fit + shape validation applied to an
+    already-restored raw tree (:func:`load_raw`'s output) — consumers that
+    must inspect the raw tree first (the serve engine probes for int8
+    ``qscale`` leaves) pay ONE file read + msgpack decode, not two.
+    ``path`` only labels error messages."""
+    template = _wrap_rng(like) if isinstance(like, dict) and "rng" in like else like
+    restored = serialization.from_state_dict(template, raw)
     got_leaves = jax.tree_util.tree_leaves(restored)
     want = jax.tree_util.tree_leaves_with_path(template)
     got_shapes = [getattr(l, "shape", None) for l in got_leaves]

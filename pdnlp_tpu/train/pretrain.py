@@ -32,7 +32,7 @@ import numpy as np
 import optax
 
 from pdnlp_tpu.data.corpus import load_data, split_data
-from pdnlp_tpu.data.packing import pack_texts, segment_bias
+from pdnlp_tpu.data.packing import pack_texts
 from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, get_or_build_vocab
 from pdnlp_tpu.models import bert, get_config
 from pdnlp_tpu.models.config import args_overrides
@@ -113,7 +113,8 @@ def build_mlm_step(cfg, tx, args, mask_id: int):
         hidden, aux = bert.encode(
             params, cfg, ids, jnp.zeros_like(ids), (seg > 0).astype(jnp.int32),
             dtype=dtype, deterministic=False, rng=k_drop, remat=remat,
-            attn_bias=segment_bias(seg), unroll=unroll, with_aux=True,
+            attn_impl=args.attention_impl, segment_ids=seg, unroll=unroll,
+            with_aux=True,
         )
         logits = bert.mlm_logits(params, params["mlm"], cfg, hidden, dtype=dtype)
         logp = jax.nn.log_softmax(logits)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import optax
 
 from pdnlp_tpu.models import BertConfig, bert
+from pdnlp_tpu.ops.fused_ce import fused_weighted_ce, resolve_fused_ce
 from pdnlp_tpu.train.precision import resolve_dtype
 
 State = Dict[str, Any]  # {'params', 'opt_state', 'step', 'rng'}
@@ -120,30 +121,43 @@ def build_train_step(cfg: BertConfig, tx: optax.GradientTransformation, args,
     HBM the fp32 moments no longer occupy."""
     dtype = resolve_dtype(args.dtype)
     remat = bool(args.remat)
-    attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
+    # "auto" flows through: ops.attention.routed_impl resolves it at trace
+    # time with the batch's real shape/packedness/dropout in hand
+    attn_impl = args.attention_impl
     unroll = _unroll(args)
     smoothing = args.label_smoothing
+    fused_ce = resolve_fused_ce(args)
 
     def loss_fn(params, batch, rng):
         # aux is the MoE load-balancing loss, a constant 0 for dense models
         # (XLA folds the add away); it joins the optimized objective only —
         # the reported loss stays bare CE so MoE and dense runs read on the
         # same scale
-        logits, aux = bert.classify(
+        out, aux = bert.classify(
             params, cfg, batch, dtype=dtype, deterministic=False, rng=rng,
             remat=remat, attn_impl=attn_impl, unroll=unroll, return_aux=True,
+            return_pooled=fused_ce == "pallas",
         )
-        # packed rows return per-SEGMENT logits [B, M, C] with [B, M]
+        # packed rows return per-SEGMENT outputs [B, M, .] with [B, M]
         # labels/weights: flatten to the per-example stream — the weighted
         # CE below is then exactly the unpacked loss over the same
         # examples (empty slots weigh 0, like filler rows)
         labels, weights = batch["label"], batch["example_weight"]
-        if logits.ndim == 3:
-            logits = logits.reshape(-1, logits.shape[-1])
+        if out.ndim == 3:
+            out = out.reshape(-1, out.shape[-1])
             labels = labels.reshape(-1)
             weights = weights.reshape(-1)
-        loss, correct, objective = weighted_ce(
-            logits, labels, weights, smoothing=smoothing)
+        if fused_ce == "pallas":
+            # ``out`` is the pooled pre-classifier features: the kernel
+            # consumes the final projection itself, so the [T, C] logits
+            # never round-trip HBM (ops.fused_ce)
+            loss, correct, objective = fused_weighted_ce(
+                out, params["classifier"]["kernel"].astype(dtype),
+                params["classifier"]["bias"].astype(dtype),
+                labels, weights, smoothing=smoothing)
+        else:
+            loss, correct, objective = weighted_ce(
+                out, labels, weights, smoothing=smoothing)
         return objective + cfg.moe_aux_coef * aux, (loss, correct)
 
     ema_decay = getattr(args, "ema_decay", 0.0)
@@ -250,7 +264,7 @@ def build_eval_step(cfg: BertConfig, args) -> Callable[..., Metrics]:
     already global.
     """
     dtype = resolve_dtype(args.dtype)
-    attn_impl = args.attention_impl if args.attention_impl != "auto" else "xla"
+    attn_impl = args.attention_impl  # ops.attention routes "auto" per trace
     unroll = _unroll(args)
 
     def eval_step(params, batch) -> Metrics:

@@ -128,6 +128,18 @@ class Trainer:
         object (identity-keyed, like the eval cache)."""
         return self.pipeline is not None and self.pipeline.loader is loader
 
+    def _routed_attn(self, seq: int, segmented: bool) -> str:
+        """The attention impl a train dispatch at this (static) shape routes
+        to — ``ops.attention.routed_impl``, the same decision the traced
+        step resolves (memoized at the routing point, so the hot loop pays
+        a dict hit)."""
+        from pdnlp_tpu.ops.attention import routed_impl_cached
+
+        return routed_impl_cached(
+            getattr(self.args, "attention_impl", "auto"), seq,
+            segmented=segmented,
+            dropout=getattr(self.args, "attn_dropout", 0.0) > 0)
+
     def _first_device_batch(self, train_loader):
         """One device batch shaped/placed exactly like the hot loop's."""
         if self._use_pipeline(train_loader):
@@ -348,6 +360,11 @@ class Trainer:
                     # time goes (int() — shape dims must not leak numpy
                     # scalars into span attrs)
                     seq = int(batch["input_ids"].shape[-1])
+                    # the attention impl this dispatch actually routes to
+                    # (ops.attention.routed_impl — the same decision the
+                    # traced step makes), stamped on the dispatch span so
+                    # pallas adoption is visible in trace_tpu.py summarize
+                    impl = self._routed_attn(seq, "segment_ids" in batch)
                     if fused:
                         if use_pipe:
                             dev = batch
@@ -357,7 +374,7 @@ class Trainer:
                             if stage is not None:
                                 stage.verify(batch, dev)  # aliasing guard, once
                         with tr.span("step_dispatch", step=gstep + n, n=n,
-                                     bucket=seq):
+                                     bucket=seq, attn_impl=impl):
                             self.state, metrics = self.multi_step(self.state, dev)
                         last_loss = metrics["loss"][-1]
                     else:
@@ -367,7 +384,7 @@ class Trainer:
                             with tr.span("h2d_put", step=gstep + n):
                                 dev = self.put(batch)
                         with tr.span("step_dispatch", step=gstep + n, n=n,
-                                     bucket=seq):
+                                     bucket=seq, attn_impl=impl):
                             self.state, metrics = self.train_step(self.state, dev)
                         last_loss = metrics["loss"]
                     # traced runs attribute device time to a separate

@@ -97,7 +97,35 @@ class Args:
                                                   # (DeepSpeed offload analog;
                                                   # ~4x step cost, frees ~8
                                                   # bytes/param of HBM)
-    attention_impl: str = "auto"                  # auto|xla|pallas
+    attention_impl: str = "auto"                  # auto|xla|pallas (CLI alias
+                                                  # --attn_impl).  auto =
+                                                  # the measured routing:
+                                                  # segment-native pallas
+                                                  # flash attention for
+                                                  # PACKED batches on a TPU
+                                                  # backend (no [B,1,S,S]
+                                                  # segment_bias in HBM),
+                                                  # XLA elsewhere; dropout
+                                                  # and non-128-tiling
+                                                  # widths always take XLA
+                                                  # (ops.attention
+                                                  # .routed_impl)
+    fused_ce: str = "auto"                        # auto|xla|pallas: fused
+                                                  # classifier-projection +
+                                                  # weighted-CE kernel in
+                                                  # the train step (ops.
+                                                  # fused_ce; logits never
+                                                  # round-trip HBM).  auto =
+                                                  # pallas on TPU, XLA
+                                                  # reference path elsewhere
+    serve_dtype: str = "auto"                     # serve forward precision:
+                                                  # auto (= --dtype, legacy)
+                                                  # | bf16 | int8 (per-
+                                                  # channel int8 weights +
+                                                  # bf16 activations,
+                                                  # serve/quant.py; artifact
+                                                  # via scripts/
+                                                  # quantize_ckpt.py)
     scan_unroll: Optional[int] = None             # layer-scan unroll; None =
                                                   # full (14% faster step,
                                                   # measured), 1 = lax.scan
@@ -297,6 +325,11 @@ def parse_cli(argv=None, base: Optional[Args] = None) -> Args:
 
     p = argparse.ArgumentParser()
     add_dataclass_args(p, Args, defaults=base or Args())
+    # short alias for the kernel escape hatch (README "Kernels" section);
+    # SUPPRESS keeps the primary --attention_impl default authoritative
+    p.add_argument("--attn_impl", dest="attention_impl", type=str,
+                   default=argparse.SUPPRESS,
+                   help="alias for --attention_impl (auto|xla|pallas)")
     ns = p.parse_args(argv)
     args = Args(**vars(ns))
     enable_compilation_cache(args)

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Offline int8 weight quantization of a committed checkpoint.
+
+Produces the serving artifact ``--serve_dtype int8`` can load directly:
+every dense block's kernel stored as per-channel symmetric int8 + one fp32
+scale per output channel (``pdnlp_tpu.serve.quant`` — the identical math
+the engine applies when quantizing a float checkpoint on the fly, so the
+two routes can never disagree).  Calibration is weight-only: no data, no
+device — this runs anywhere the checkpoint file does.
+
+    python scripts/quantize_ckpt.py output/dp-cls.msgpack
+    # -> output/dp-cls.int8.msgpack + a per-block error report
+
+    python serve_tpu.py --serve_dtype int8 --ckpt output/dp-cls.int8.msgpack
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flax import serialization  # noqa: E402
+
+from pdnlp_tpu.serve.quant import (  # noqa: E402
+    is_quantized, quant_error_report, quantize_params,
+)
+from pdnlp_tpu.train import checkpoint as ckpt  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint", help="params checkpoint (.msgpack)")
+    p.add_argument("-o", "--output", default=None,
+                   help="artifact path (default: <checkpoint>.int8.msgpack)")
+    ns = p.parse_args(argv)
+
+    params = ckpt.load_raw(ns.checkpoint)
+    if is_quantized(params):
+        print(f"{ns.checkpoint} is already an int8 artifact", file=sys.stderr)
+        return 1
+    qparams = quantize_params(params)
+    report = quant_error_report(params, qparams)
+    if not report:
+        print(f"{ns.checkpoint}: no dense blocks found — not a params "
+              "checkpoint?", file=sys.stderr)
+        return 1
+
+    out = ns.output or (ns.checkpoint.rsplit(".msgpack", 1)[0]
+                        + ".int8.msgpack")
+    data = serialization.to_bytes(qparams)
+    tmp = out + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, out)  # atomic, like checkpoint.save
+
+    in_bytes = os.path.getsize(ns.checkpoint)
+    print(f"wrote {out}  ({in_bytes / 1e6:.1f} MB -> "
+          f"{os.path.getsize(out) / 1e6:.1f} MB)")
+    print(f"{'block':<28} {'max|dW|':>10} {'rel':>8}")
+    for path, (err, rel) in sorted(report.items()):
+        print(f"{path:<28} {err:>10.2e} {rel:>8.2%}")
+    worst = max(rel for _, rel in report.values())
+    print(f"worst per-block relative error: {worst:.2%} "
+          "(symmetric per-channel int8 bound: <= 1/127 of the channel amax)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

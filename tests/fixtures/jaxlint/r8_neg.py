@@ -1,0 +1,40 @@
+"""R8 negative: routed impls, CLI pins, and A/B probes outside hot paths."""
+import jax
+
+from pdnlp_tpu.models import bert
+from pdnlp_tpu.ops.attention import dot_product_attention
+
+
+def build_train_step(cfg, args):
+    attn_impl = args.attention_impl      # routed: "auto" resolves per trace
+
+    def loss_fn(params, batch):
+        return bert.classify(params, cfg, batch, attn_impl=attn_impl)
+
+    return loss_fn
+
+
+def bench_ab(q, k, v, bias):
+    # A/B probe: the impl is a loop VARIABLE, and the function is not a
+    # step builder — deliberate comparisons stay lintable
+    times = {}
+    for impl in ("xla", "pallas"):
+        times[impl] = dot_product_attention(q, k, v, bias, impl=impl)
+    return times
+
+
+def reference_oracle(q, k, v, bias):
+    # an explicitly-named parity oracle outside any hot path
+    return dot_product_attention(q, k, v, bias, impl="xla")
+
+
+def build_eval_step(cfg, args):
+    fallback = "xla" if args.dropout else args.attention_impl
+    # a non-impl-named variable fed by config, not a literal pin on the
+    # call; and the IfExp guard is dropout feasibility, assigned to a
+    # name the rule does not own
+
+    def eval_step(params, batch):
+        return bert.classify(params, cfg, batch, attn_impl=fallback)
+
+    return eval_step

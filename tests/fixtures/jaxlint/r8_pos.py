@@ -1,0 +1,29 @@
+"""R8 positive: attention pinned to XLA inside hot-path step builders."""
+import jax
+
+from pdnlp_tpu.models import bert
+from pdnlp_tpu.ops.attention import dot_product_attention
+
+
+def build_train_step(cfg, args):
+    def loss_fn(params, batch, q, k, v, bias):
+        out = dot_product_attention(q, k, v, bias, impl="xla")  # line 10
+        logits = bert.classify(params, cfg, batch,
+                               attn_impl="xla")                 # line 12
+        return out, logits
+
+    return loss_fn
+
+
+def make_serve_step(cfg, args):
+    attn_impl = args.attention_impl if args.attention_impl != "auto" \
+        else "xla"                                              # line 19 (assign)
+
+    def _forward(params, batch):
+        return bert.classify(params, cfg, batch, attn_impl=attn_impl)
+
+    return _forward
+
+
+def eval_step(params, q, k, v):
+    return jax.nn.dot_product_attention(q, k, v)                # line 29

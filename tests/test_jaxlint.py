@@ -123,6 +123,24 @@ def test_r7_hint_points_at_the_pipeline():
     assert "pdnlp_tpu.data.pipeline" in f.hint
 
 
+def test_r8_xla_attention_positive():
+    # literal impl pin (10), literal attn_impl pin (12), the legacy
+    # auto-demotion IfExp (19), library XLA attention (29)
+    assert all_hits("r8_pos.py") == [("R8", 10), ("R8", 12), ("R8", 19),
+                                     ("R8", 29)]
+
+
+def test_r8_xla_attention_negative():
+    assert hits("r8_neg.py", "R8") == []
+
+
+def test_r8_hint_points_at_attn_impl():
+    path = os.path.join(FIXTURES, "r8_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R8"][0]
+    assert "--attn_impl" in f.hint
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -132,7 +150,8 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    assert list(all_rules()) == ["R1", "R2", "R3", "R4", "R5", "R6", "R7"]
+    assert list(all_rules()) == ["R1", "R2", "R3", "R4", "R5", "R6", "R7",
+                                 "R8"]
 
 
 # -------------------------------------------------------------- suppressions

@@ -65,6 +65,12 @@ def cmd_diff(ns) -> int:
                   f"{am * 1e3 if am else float('nan'):>10.3f} "
                   f"{bm * 1e3 if bm else float('nan'):>10.3f} "
                   f"{f'{d:+.1%}' if d is not None else 'n/a':>8}{mark}")
+        impls = diff.get("impls")
+        if impls and impls["changed"]:
+            # a phase delta alongside this line is attributable: the two
+            # runs did not execute the same kernels/precision
+            print(f"impl mix changed: base={impls['base']} "
+                  f"cand={impls['cand']}")
     if diff["regressions"]:
         print(f"REGRESSION: phase(s) {', '.join(diff['regressions'])} mean "
               f"grew >= {ns.threshold:.0%} vs {ns.base}", file=sys.stderr)
