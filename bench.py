@@ -1117,8 +1117,231 @@ def kernel_smoke(argv) -> None:
                  + f"\nsee {out_path}")
 
 
+def resilience_smoke(argv) -> None:
+    """``--resilience``: preemption-grade training smoke.
+
+    Two gated blocks, written to ``results/resilience_smoke.json``
+    (override ``--resilience_out``), non-zero exit on any violation:
+
+    1. **save-pause A/B** — a seeded bert-tiny step loop saving full train
+       state every ``--resilience_save_every`` steps, once through the
+       synchronous ``checkpoint.save_state`` and once through the async
+       writer (snapshot-in-loop + background publish).  Records the
+       step-loop pause per save (mean/p95/max ms) for both, the async
+       drain time, and writer stats.  Gates: every published file passes
+       manifest verification, and the async writer ran with at most one
+       save in flight (structural: one writer thread; the recorded stats
+       must agree).
+    2. **kill injection** — a width-1 elastic gang (CPU backend, 4 virtual
+       devices) SIGKILLed mid-epoch; the supervisor must restart it from
+       the async-published snapshot.  Gates: **zero lost optimizer steps**
+       (the final train line reports step N/N — every remaining step ran
+       after the restart), exactly one restart, and **bounded recovery**
+       (total wall under ``--resilience_recovery_s``, default 600).  Runs
+       single-process so the smoke is honest on images whose jax cannot
+       form cross-process CPU gangs (the eviction-at-reduced-width path is
+       chaos-tested in ``tests/test_chaos.py`` where the backend allows).
+    """
+    import re
+    import subprocess
+    import tempfile
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from pdnlp_tpu.train import checkpoint as ckpt
+    from pdnlp_tpu.train.async_ckpt import AsyncCheckpointer
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, out_path = pop_cli_flag(
+        argv, "--resilience_out",
+        os.path.join("results", "resilience_smoke.json"))
+    argv, n_steps = pop_cli_flag(argv, "--resilience_steps", 18, int)
+    argv, save_every = pop_cli_flag(argv, "--resilience_save_every", 3, int)
+    argv, recovery_bound = pop_cli_flag(argv, "--resilience_recovery_s",
+                                        600.0, float)
+    if n_steps < save_every:
+        sys.exit(f"--resilience_steps ({n_steps}) must be >= "
+                 f"--resilience_save_every ({save_every}): the smoke needs "
+                 "at least one save to measure")
+    args = parse_cli(argv, base=Args(
+        strategy="dp", model="bert-tiny", data_limit=600, max_seq_len=32,
+        train_batch_size=8, dtype="float32", dropout=0.0, attn_dropout=0.0,
+        epochs=1, log_every=10 ** 9))
+
+    fresh_loader, mesh, state0, step, put = _smoke_train_setup(args)
+    batch = put(next(iter(fresh_loader())))
+    tmp_dir = tempfile.mkdtemp(prefix="resilience_")
+
+    def timed_saves(variant):
+        state = jax.tree_util.tree_map(jnp.copy, state0)
+        path = os.path.join(tmp_dir, f"{variant}.msgpack")
+        writer = AsyncCheckpointer() if variant == "async" else None
+        pauses = []
+        state, m = step(state, batch)  # compile outside the timed loop
+        float(jax.device_get(m["loss"]))
+        for i in range(n_steps):
+            state, m = step(state, batch)
+            if (i + 1) % save_every == 0:
+                t0 = _time.perf_counter()
+                if writer is None:
+                    # the sync baseline IS the hazard being measured
+                    # jaxlint: disable=R9 — A/B baseline for the async saver
+                    ckpt.save_state(path, state, meta={"step": i + 1})
+                else:
+                    writer.submit(path, ckpt.snapshot(state),
+                                  meta={"step": i + 1})
+                # the STEP-LOOP PAUSE is the metric: sync saves block
+                # internally (consolidate fetches), async deliberately
+                # measures snapshot+enqueue only — no barrier wanted
+                # jaxlint: disable=R4 — the unblocked pause IS the metric
+                pauses.append(_time.perf_counter() - t0)
+        float(jax.device_get(m["loss"]))
+        drain_s = 0.0
+        stats = writer_error = None
+        if writer is not None:
+            t0 = _time.perf_counter()
+            try:
+                writer.wait()  # host-side thread join, not device dispatch
+            except RuntimeError as e:
+                # a failed publish must surface as a GATED violation in the
+                # JSON result, not an unhandled traceback
+                writer_error = str(e.__cause__ or e)
+            # jaxlint: disable=R4 — times the writer drain, no device work
+            drain_s = _time.perf_counter() - t0
+            stats = writer.stats()
+        del state
+        ok, reason = ckpt.verify(path)
+        p = sorted(pauses)
+        row = {"variant": variant, "saves": len(pauses),
+               "pause_mean_ms": round(sum(p) / len(p) * 1e3, 3),
+               "pause_p95_ms": round(p[int(0.95 * (len(p) - 1))] * 1e3, 3),
+               "pause_max_ms": round(p[-1] * 1e3, 3),
+               "drain_s": round(drain_s, 3),
+               "manifest_ok": ok, "manifest_reason": reason}
+        if stats is not None:
+            row["writer"] = stats
+        if writer_error is not None:
+            row["writer_error"] = writer_error
+        return row
+
+    sync_row = timed_saves("sync")
+    async_row = timed_saves("async")
+
+    # ---- kill injection: width-1 elastic gang, SIGKILL mid-epoch --------
+    kill_dir = os.path.join(tmp_dir, "gang")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               PYTHONUNBUFFERED="1", PDNLP_SPAWN_PORT="12421",
+               PDNLP_FAULT_STEP="5", PDNLP_FAULT_PROC="0",
+               PDNLP_FAULT_KIND="sigkill")
+    for k in ("COORDINATOR_ADDRESS", "PROCESS_ID"):
+        env.pop(k, None)
+    corpus = args.data_path
+    if not os.path.exists(corpus):
+        import random as _random
+
+        corpus = os.path.join(tmp_dir, "corpus.json")
+        rng = _random.Random(args.seed)
+        chars = "天地人你我他好坏大小上下来去爱恨喜怒哀乐高兴悲伤讨厌愤怒"
+        rows = [[" ".join(rng.choice(chars)
+                          for _ in range(rng.randint(4, 30))),
+                 rng.randrange(args.num_labels)] for _ in range(600)]
+        with open(corpus, "w", encoding="utf-8") as f:
+            json.dump(rows, f, ensure_ascii=False)
+    t0 = _time.monotonic()
+    timed_out = False
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "multi-tpu-spawn-cls.py"),
+             "--num_processes", "1", "--elastic", "true", "--resume_every",
+             "2", "--stall_timeout", "60", "--log_every", "1",
+             "--output_dir", kill_dir, "--data_path", corpus,
+             "--model", "bert-tiny", "--data_limit", "256", "--max_seq_len",
+             "32", "--train_batch_size", "4", "--dtype", "float32",
+             "--dropout", "0.0", "--attn_dropout", "0.0", "--epochs", "1"],
+            capture_output=True, text=True, timeout=recovery_bound, env=env)
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        # the recovery-bound violation must be a GATED result, not a crash
+        timed_out = True
+        rc = -1
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+            else (e.stderr or "")
+    # jaxlint: disable=R4 — wall-clock of a subprocess, no device dispatch
+    wall_s = _time.monotonic() - t0
+    restarts = len(re.findall(r"restart \d+/", err))
+    steps_line = re.findall(r"step：(\d+)/(\d+)", out)
+    final_step, total_step = (int(steps_line[-1][0]), int(steps_line[-1][1])) \
+        if steps_line else (0, -1)
+    kill_row = {
+        "completed": rc == 0,
+        "timed_out": timed_out,
+        "restarts": restarts,
+        "final_step": final_step, "total_step": total_step,
+        "lost_optimizer_steps": total_step - final_step,
+        "recovery_wall_s": round(wall_s, 1),
+        "recovery_bound_s": recovery_bound,
+    }
+
+    violations = []
+    for row in (sync_row, async_row):
+        if not row["manifest_ok"]:
+            violations.append(f"{row['variant']}: published checkpoint "
+                              f"fails manifest validation "
+                              f"({row['manifest_reason']})")
+    if async_row.get("writer_error"):
+        violations.append(f"async writer publish failed: "
+                          f"{async_row['writer_error']}")
+    if not kill_row["completed"]:
+        violations.append("killed gang did not complete: "
+                          + ("recovery bound hit"
+                             if timed_out else f"rc {rc}")
+                          + f"; {err[-500:]}")
+    if kill_row["lost_optimizer_steps"] != 0:
+        violations.append(f"lost optimizer steps: {kill_row}")
+    if kill_row["restarts"] != 1:
+        violations.append(f"expected exactly 1 restart, saw "
+                          f"{kill_row['restarts']}")
+    if wall_s > recovery_bound:
+        violations.append(f"recovery {wall_s:.0f}s over bound "
+                          f"{recovery_bound:.0f}s")
+
+    result = {
+        "metric": "resilience_smoke",
+        "model": args.model,
+        "batch_size": args.train_batch_size,
+        "seq_len": args.max_seq_len,
+        "devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+        "steps": n_steps, "save_every": save_every,
+        "save_pause": {"sync": sync_row, "async": async_row},
+        "kill_injection": kill_row,
+        "violations": violations,
+        "ok": not violations,
+    }
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    if violations:
+        sys.exit("resilience smoke FAILED: " + "; ".join(violations))
+
+
 def main() -> None:
     argv = sys.argv[1:]
+    if "--resilience" in argv:
+        # resilience smoke intercept (async-save pause A/B + kill
+        # injection, results/resilience_smoke.json) — like --kernels, not
+        # an Args knob
+        argv.remove("--resilience")
+        return resilience_smoke(argv)
     if "--trace" in argv:
         # like --pipeline: a bench smoke intercept, not the Args.trace
         # bool (a traced HEADLINE run is `--trace true` on the ordinary

@@ -29,13 +29,15 @@ from pdnlp_tpu.utils.config import Args, parse_cli
 _PORT = int(os.environ.get("PDNLP_SPAWN_PORT", "12355"))
 
 
-def _launch_gang(args, extra_argv) -> list:
+def _launch_gang(args, extra_argv, num_processes=None) -> list:
+    width = num_processes if num_processes is not None \
+        else (args.num_processes or 1)
     procs = []
-    for pid in range(args.num_processes):
+    for pid in range(width):
         env = dict(os.environ)
         env.update(
             COORDINATOR_ADDRESS=f"localhost:{_PORT}",
-            NUM_PROCESSES=str(args.num_processes),
+            NUM_PROCESSES=str(width),
             PROCESS_ID=str(pid),
         )
         procs.append(subprocess.Popen(
@@ -47,15 +49,20 @@ def spawn(args) -> int:
     """Fork ``num_processes`` copies of this script with PROCESS_ID set
     (the ``mp.spawn(main_worker, nprocs=N)`` analog).
 
-    With ``--elastic true`` the parent is also a failure detector (the
-    capability the reference entirely lacks — a dead rank leaves its NCCL
-    peers hung forever): workers heartbeat and snapshot full train state
-    every ``--resume_every`` steps; if any child crashes or the stalest
-    heartbeat exceeds ``--stall_timeout``, the parent kills the WHOLE gang
-    (SPMD collectives cannot absorb a lone replacement rank) and relaunches
-    it from the newest snapshot — a bitwise continuation, since resume
-    restores params + Adam moments + step + RNG and the data order is a
-    seeded permutation (``tests/test_resume.py``).
+    With ``--elastic true`` the parent becomes a degrade-don't-die gang
+    supervisor (``parallel/watchdog.GangSupervisor`` — the capability the
+    reference entirely lacks: a dead rank leaves its NCCL peers hung
+    forever): workers heartbeat and snapshot full train state every
+    ``--resume_every`` steps; if any child crashes or the stalest heartbeat
+    exceeds ``--stall_timeout``, the parent kills the WHOLE gang (SPMD
+    collectives cannot absorb a lone replacement rank), EVICTS ranks
+    classified dead (``--elastic_shrink``, default on), and relaunches the
+    survivors from the newest snapshot with capped exponential backoff and
+    a restart budget.  A same-width restart is a bitwise continuation
+    (resume restores params + Adam moments + step + RNG over the seeded
+    data order, ``tests/test_resume.py``/``tests/test_elastic.py``); a
+    reduced-width restart remaps the data position by epoch fraction and
+    reshards state onto the surviving mesh (``tests/test_chaos.py``).
     """
     if not args.elastic:
         procs = _launch_gang(args, [])
@@ -65,19 +72,20 @@ def spawn(args) -> int:
         return rc
 
     import shutil
-    import time
 
-    from pdnlp_tpu.parallel.watchdog import GangMonitor, heartbeat_dir
+    from pdnlp_tpu.parallel.watchdog import GangSupervisor, heartbeat_dir
+    from pdnlp_tpu.train import checkpoint as ckpt
 
     # A previous run's AUTO snapshot would make fresh workers "resume" at
     # its final step and train nothing — elastic state is per-run.  A
     # user-supplied --resume_from is the opposite intent (continue THAT
     # run) and is left strictly alone.
     if not args.resume_from or args.resume_from == "auto":
-        for stale in (args.resume_path(), args.resume_path() + "-best",
-                      args.resume_path() + "-best.json"):
-            if os.path.exists(stale):
-                os.remove(stale)
+        ckpt.discard(args.resume_path())
+        ckpt.discard(args.resume_path() + "-best")
+        best_json = args.resume_path() + "-best.json"
+        if os.path.exists(best_json):
+            os.remove(best_json)
     shutil.rmtree(heartbeat_dir(args.output_dir), ignore_errors=True)
 
     worker_argv = ["--heartbeat_interval",
@@ -85,31 +93,32 @@ def spawn(args) -> int:
                    "--resume_every", str(args.resume_every or 10)]
     if not args.resume_from:
         worker_argv += ["--resume_from", "auto"]
-    restarts = 0
-    while True:
-        procs = _launch_gang(args, worker_argv)
-        mon = GangMonitor(procs, args.output_dir, args.num_processes,
-                          stall_timeout=args.stall_timeout)
-        verdict = None
-        while verdict is None:
-            time.sleep(0.2)
-            verdict = mon.poll()
-        if verdict["kind"] == "done":
-            return 0
-        mon.kill_gang()
-        if restarts >= args.max_restarts:
-            print(f"[elastic] giving up after {restarts} restarts: {verdict}",
-                  file=sys.stderr)
-            return 1
-        restarts += 1
-        print(f"[elastic] gang failure {verdict} — restart {restarts}/"
-              f"{args.max_restarts} from latest snapshot", file=sys.stderr)
+
+    def launch(width):
+        # --num_processes last wins in argparse, and _launch_gang sets the
+        # matching NUM_PROCESSES env — a shrunken gang rendezvouses at its
+        # new world size and its workers rebuild mesh/loaders/shardings at
+        # the surviving width (elastic-width resume remaps the rest)
+        return _launch_gang(args, worker_argv + ["--num_processes",
+                                                 str(width)],
+                            num_processes=width)
+
+    return GangSupervisor(
+        launch, args.output_dir, args.num_processes or 1,
+        stall_timeout=args.stall_timeout, max_restarts=args.max_restarts,
+        shrink=args.elastic_shrink, min_processes=args.min_processes,
+        backoff=args.restart_backoff, backoff_cap=args.restart_backoff_cap,
+    ).run()
 
 
 def main() -> int:
     args = parse_cli(base=Args(strategy="spawn"))
     already_child = os.environ.get("PROCESS_ID") is not None
-    if args.num_processes and args.num_processes > 1 and not already_child \
+    multi = bool(args.num_processes and args.num_processes > 1)
+    # --elastic also supervises a WIDTH-1 gang: a single preemptible worker
+    # still wants SIGKILL detection + restart-from-snapshot (and it is the
+    # resume target a shrunken gang degrades to)
+    if (multi or args.elastic) and not already_child \
             and args.process_id is None:
         return spawn(args)
     # --mode picks the sharding the gang executes: dp (default, the

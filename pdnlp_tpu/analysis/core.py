@@ -422,6 +422,43 @@ def parse_module(path: str, display_path: str) -> Optional[ModuleInfo]:
     return ModuleInfo(display_path, source, tree)
 
 
+# ------------------------------------------------------------ loop utilities
+
+#: the repo's jitted-step naming convention (R5 polices it stays
+#: meaningful) — shared by the step-loop rules (R7, R9)
+STEP_CALL_RE = re.compile(r"^\w*step(_fn)?$")
+
+
+def loop_body_calls(mod: ModuleInfo, loop: ast.AST) -> List[ast.Call]:
+    """Calls lexically inside ``loop``'s body.  Bodies of functions DEFINED
+    inside the loop are excluded (they do not run per iteration of this
+    loop; their own loops are judged separately); nested loops' bodies are
+    included (still per-iteration work)."""
+    body = list(loop.body) + list(getattr(loop, "orelse", []))
+    nested = {n for stmt in body for n in ast.walk(stmt)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda))}
+
+    def under_nested(node: ast.AST) -> bool:
+        p = mod.parents.get(node)
+        while p is not None and p is not loop:
+            if p in nested:
+                return True
+            p = mod.parents.get(p)
+        return False
+
+    return [n for stmt in body for n in ast.walk(stmt)
+            if isinstance(n, ast.Call) and not under_nested(n)]
+
+
+def is_step_call(call: ast.Call) -> bool:
+    """Does this call dispatch a jitted step, by the naming convention?"""
+    name = dotted_name(call.func)
+    if not name:
+        return False
+    return bool(STEP_CALL_RE.fullmatch(name.split(".")[-1]))
+
+
 # -------------------------------------------------------------------- registry
 
 class Rule:

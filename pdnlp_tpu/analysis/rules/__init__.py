@@ -12,4 +12,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r6_mesh_axes,
     r7_put_in_loop,
     r8_xla_attention,
+    r9_blocking_ckpt,
 )

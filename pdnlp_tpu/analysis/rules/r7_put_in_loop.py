@@ -26,10 +26,11 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Iterator, List
+from typing import Iterator
 
 from pdnlp_tpu.analysis.core import (
-    Finding, ModuleInfo, Rule, dotted_name, register,
+    Finding, ModuleInfo, Rule, dotted_name, is_step_call, loop_body_calls,
+    register,
 )
 
 _PUT_FUNCS = {
@@ -38,7 +39,6 @@ _PUT_FUNCS = {
 }
 _PUT_NAME_RE = re.compile(r"^put(_fused)?$")
 _QUEUE_RECV_RE = re.compile(r"^(q|queue|.*_q|.*queue)$", re.IGNORECASE)
-_STEP_NAME_RE = re.compile(r"^\w*step(_fn)?$")
 
 
 @register
@@ -57,8 +57,8 @@ class PutInStepLoop(Rule):
         for loop in ast.walk(mod.tree):
             if not isinstance(loop, (ast.For, ast.While)):
                 continue
-            calls = self._loop_calls(mod, loop)
-            if not any(self._is_step_call(c) for c in calls):
+            calls = loop_body_calls(mod, loop)
+            if not any(is_step_call(c) for c in calls):
                 continue
             for c in calls:
                 if self._is_put_call(mod, c):
@@ -67,27 +67,6 @@ class PutInStepLoop(Rule):
                         "host->device upload inside a loop that dispatches "
                         "a jitted step — every iteration pays transport "
                         "serially with dispatch")
-
-    def _loop_calls(self, mod: ModuleInfo, loop: ast.AST) -> List[ast.Call]:
-        """Calls lexically inside ``loop``'s body.  Bodies of functions
-        DEFINED inside the loop are excluded (they do not run per
-        iteration of this loop; their own loops are judged separately);
-        nested loops' bodies are included (still per-iteration work)."""
-        body = list(loop.body) + list(getattr(loop, "orelse", []))
-        nested = {n for stmt in body for n in ast.walk(stmt)
-                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                    ast.Lambda))}
-
-        def under_nested(node: ast.AST) -> bool:
-            p = mod.parents.get(node)
-            while p is not None and p is not loop:
-                if p in nested:
-                    return True
-                p = mod.parents.get(p)
-            return False
-
-        return [n for stmt in body for n in ast.walk(stmt)
-                if isinstance(n, ast.Call) and not under_nested(n)]
 
     def _is_put_call(self, mod: ModuleInfo, call: ast.Call) -> bool:
         if mod.resolves_to(call.func, _PUT_FUNCS):
@@ -102,9 +81,3 @@ class PutInStepLoop(Rule):
         if len(parts) > 1 and _QUEUE_RECV_RE.fullmatch(parts[-2]):
             return False
         return True
-
-    def _is_step_call(self, call: ast.Call) -> bool:
-        name = dotted_name(call.func)
-        if not name:
-            return False
-        return bool(_STEP_NAME_RE.fullmatch(name.split(".")[-1]))

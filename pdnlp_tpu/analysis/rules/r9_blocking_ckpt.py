@@ -1,0 +1,95 @@
+"""R9 — synchronous checkpoint writes inside a step loop.
+
+A ``checkpoint.save*`` call in the same loop that dispatches a jitted step
+serializes the FULL train state to msgpack and writes + fsyncs it to disk
+before the next step can even be enqueued — the step loop stalls on host
+CPU and disk for work that has no ordering dependency on it beyond the
+device→host snapshot.  The async checkpointer
+(``pdnlp_tpu.train.async_ckpt``) exists to split the save at exactly that
+line: the loop pays the snapshot, a writer thread pays serialization and
+the crash-atomic publish, double-buffered with at most one save in flight.
+
+Heuristic, per lexical ``for``/``while`` loop (sharing R7's loop-body
+machinery): the loop body contains BOTH
+
+- a step dispatch — a call whose name's last segment ends in ``step``/
+  ``step_fn`` (the repo's jitted-step naming convention);
+- a synchronous checkpoint write — a call resolving to
+  ``pdnlp_tpu.train.checkpoint.save``/``save_state``/``save_params``
+  (through import aliases, e.g. ``ckpt.save_state``), or any call whose
+  last name segment is ``save_state``/``save_params``/``save_resume``/
+  ``save_checkpoint``/``save_ckpt`` (``self.save_resume(...)``, the
+  trainer convention).
+
+``AsyncCheckpointer.submit`` and ``checkpoint.snapshot`` deliberately do
+NOT match: snapshot-in-loop + submit IS the fix.  Epoch-level saves inside
+an epoch loop that contains the step loop are still findings — they block
+the NEXT epoch's first step the same way.  The finding lands on the save
+call.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from pdnlp_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, dotted_name, is_step_call, loop_body_calls,
+    register,
+)
+
+_CKPT_SAVE_FUNCS = {
+    "pdnlp_tpu.train.checkpoint.save",
+    "pdnlp_tpu.train.checkpoint.save_state",
+    "pdnlp_tpu.train.checkpoint.save_params",
+}
+_SAVE_NAME_RE = re.compile(r"^save_(state|params|resume|checkpoint|ckpt)$")
+
+
+@register
+class BlockingCkptInStepLoop(Rule):
+    rule_id = "R9"
+    name = "blocking-ckpt-in-step-loop"
+    hint = ("keep only the device->host snapshot on the step loop: route "
+            "the write through pdnlp_tpu.train.async_ckpt.AsyncCheckpointer "
+            "— writer.submit(path, checkpoint.snapshot(state)) — so "
+            "serialization and the crash-atomic publish ride the writer "
+            "thread (at most one save in flight, step loop never blocks "
+            "on disk)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not self._relevant(mod):
+            return
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            calls = loop_body_calls(mod, loop)
+            if not any(is_step_call(c) for c in calls):
+                continue
+            for c in calls:
+                if self._is_sync_save(mod, c):
+                    yield self.finding(
+                        mod, c,
+                        "synchronous checkpoint write inside a loop that "
+                        "dispatches a jitted step — the loop blocks on "
+                        "msgpack serialization + disk every save instead "
+                        "of paying the device->host snapshot only")
+
+    @staticmethod
+    def _relevant(mod: ModuleInfo) -> bool:
+        """Train-loop-shaped modules only: the file must touch jax or the
+        checkpoint module — a pure-host script's ``save_*`` helpers are
+        not device-loop stalls."""
+        if "jax" in mod.aliases or any(a.startswith("jax")
+                                       for a in mod.aliases.values()):
+            return True
+        return any(a.startswith("pdnlp_tpu.train.checkpoint")
+                   for a in mod.aliases.values())
+
+    def _is_sync_save(self, mod: ModuleInfo, call: ast.Call) -> bool:
+        if mod.resolves_to(call.func, _CKPT_SAVE_FUNCS):
+            return True
+        name = dotted_name(call.func)
+        if not name:
+            return False
+        return bool(_SAVE_NAME_RE.fullmatch(name.split(".")[-1]))

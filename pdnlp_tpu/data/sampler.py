@@ -11,6 +11,17 @@ of the global batch and the arrays are assembled into one global-sharded
 ``jax.Array`` (see ``parallel.collectives.make_global_batch``).  Indices pad
 by wrapping, like the reference's sampler, so step counts match (144 steps at
 2-way DP for the 9,200-example epoch, ``SURVEY.md`` §6).
+
+Elastic-width contract: every epoch order is a pure function of
+``(seed, epoch)`` and row assignment a pure function of
+``(num_shards, shard_id)`` over it — nothing is cached across widths — so
+a gang that resumes at a DIFFERENT data-parallel width (a dead host
+evicted, ``parallel/watchdog.GangSupervisor``) recomputes row assignment
+correctly just by being rebuilt at the new width.  Same-width resume
+replays the identical stream (bitwise continuation); across widths the
+consumed-example SET is only approximately the old prefix (the interleave
+changes), which is why ``Trainer._remap_elastic_width`` continues by epoch
+fraction and documents the few-rows skip.
 """
 from __future__ import annotations
 

@@ -18,7 +18,16 @@ phase                 host-side meaning
 ``device_block``      ``block_until_ready`` on the step's output — where
                       device compute time actually surfaces on the host
 ``eval``              the in-loop dev pass
-``ckpt_save``         resume-snapshot / checkpoint writes
+``ckpt_save``         the step loop's checkpoint pause — under the async
+                      writer (``--ckpt_async``, default) this is the
+                      device→host snapshot + enqueue ONLY (serialization
+                      and disk ride the writer thread); under
+                      ``--ckpt_async false`` it is the full synchronous
+                      save.  ``trace_tpu.py diff --ckpt_save_budget``
+                      gates its p95
+``ckpt_wait``         end-of-run drain of the async checkpoint writer —
+                      durability work off the step loop, counted in the
+                      runtime but never in ``ckpt_save``'s in-loop p95
 ``log``               formatting + printing the loss line
 ====================  =====================================================
 
@@ -35,7 +44,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 PHASES = ("data_wait", "h2d_put", "step_dispatch", "device_block",
-          "eval", "ckpt_save", "log")
+          "eval", "ckpt_save", "ckpt_wait", "log")
 
 #: the phase that marks "this optimizer-step group is finished" in a span
 #: stream (the traced loop's per-step barrier)

@@ -133,7 +133,8 @@ class RegressionDetector:
 
 def diff_breakdowns(base: Dict, cand: Dict, *, threshold: float = 0.2,
                     min_mean_sec: float = 1e-6,
-                    min_count: int = 5) -> Dict:
+                    min_count: int = 5,
+                    ckpt_save_budget: Optional[float] = None) -> Dict:
     """Per-phase mean delta of two ``StepBreakdown.summary()`` dicts.
 
     ``threshold`` is a fraction (0.2 = flag a phase whose mean grew >=20%).
@@ -145,6 +146,14 @@ def diff_breakdowns(base: Dict, cand: Dict, *, threshold: float = 0.2,
     sub-ms mean swings ±100% between identical configs; one sample is an
     anecdote, not a distribution.  Returns
     ``{"phases": {...}, "regressions": [names...]}``.
+
+    ``ckpt_save_budget`` (seconds) additionally gates the CANDIDATE
+    trace's in-loop ``ckpt_save`` p95 as an ABSOLUTE bound, independent of
+    the base trace: the async checkpointer's contract is that the step
+    loop pays the device→host snapshot only, so a p95 over budget means
+    serialization/disk crept back onto the loop (the end-of-run drain
+    reports separately as ``ckpt_wait`` and is never gated here).  A trace
+    with no ``ckpt_save`` observations passes vacuously.
     """
     phases: Dict[str, Dict] = {}
     regressions: List[str] = []
@@ -176,4 +185,12 @@ def diff_breakdowns(base: Dict, cand: Dict, *, threshold: float = 0.2,
     ia, ib = base.get("impls"), cand.get("impls")
     if ia or ib:
         out["impls"] = {"base": ia, "cand": ib, "changed": ia != ib}
+    if ckpt_save_budget is not None:
+        p95 = cand.get("phases", {}).get("ckpt_save", {}).get("p95_sec")
+        exceeded = bool(p95 is not None and p95 > ckpt_save_budget)
+        out["ckpt_save_budget"] = {"budget_sec": ckpt_save_budget,
+                                   "cand_p95_sec": p95,
+                                   "exceeded": exceeded}
+        if exceeded:
+            out["regressions"].append("ckpt_save(p95-budget)")
     return out

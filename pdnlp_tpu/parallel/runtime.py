@@ -32,16 +32,34 @@ def init_runtime(args) -> Tuple[int, int]:
     nproc = args.num_processes or _int_env("NUM_PROCESSES")
     pid = args.process_id if args.process_id is not None else _int_env("PROCESS_ID")
 
-    if coord and nproc and nproc > 1 and not jax.distributed.is_initialized():
-        # NOTE: checked via jax.distributed, not process_count() — the
-        # latter would initialize the backend, which must not happen before
-        # the distributed client is up
+    if coord and nproc and nproc > 1 and not _distributed_initialized():
+        # NOTE: checked via the distributed client, not process_count() —
+        # the latter would initialize the backend, which must not happen
+        # before the distributed client is up
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(nproc),
             process_id=int(pid or 0),
         )
     return jax.process_index(), jax.process_count()
+
+
+def _distributed_initialized() -> bool:
+    """Is the distributed client up?  ``jax.distributed.is_initialized``
+    where it exists; on older jax (this image's 0.4.37 has no such
+    attribute — every spawn worker died on it and the whole elastic suite
+    failed at init) probe the client object the same module keeps."""
+    import jax
+
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
 
 
 def _int_env(name: str):
@@ -64,9 +82,17 @@ def _honor_platform_env() -> None:
         return
     try:
         jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    try:
         m = re.search(r"xla_force_host_platform_device_count=(\d+)",
                       os.environ.get("XLA_FLAGS", ""))
         if m:
             jax.config.update("jax_num_cpu_devices", int(m.group(1)))
-    except RuntimeError:
+    except (RuntimeError, AttributeError):
+        # jax < 0.5 has no jax_num_cpu_devices option; the XLA_FLAGS
+        # host-platform override above already forces the virtual devices
+        # (same guard as tests/conftest.py) — without this, every spawn
+        # worker on such a jax died at init and the whole elastic suite
+        # failed before a single gang ever launched
         pass

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -141,17 +142,25 @@ class GangMonitor:
         age and progress never pay a second filesystem pass."""
         return [self._read_beat(i) for i in range(self.num_processes)]
 
+    def _rank_ages(self, beats: Optional[List] = None) -> List[Optional[float]]:
+        """Per-rank beat age in seconds (None = that rank has not beaten in
+        THIS incarnation — beats older than the monitor's start are
+        leftovers from a previous gang, not beats)."""
+        now = self._clock()
+        ages: List[Optional[float]] = []
+        for beat in self._read_beats() if beats is None else beats:
+            if beat is None or beat["t"] < self.started:
+                ages.append(None)
+            else:
+                ages.append(now - beat["t"])
+        return ages
+
     def _heartbeat_age(self, beats: Optional[List] = None) -> Optional[float]:
         """Age in seconds of the STALEST rank heartbeat (None before all
-        ranks have beaten).  Beats older than this monitor's start are
-        leftovers from a previous incarnation, not beats."""
-        ages = []
-        for beat in self._read_beats() if beats is None else beats:
-            if beat is None:
-                return None  # not all ranks beating yet — grace period
-            if beat["t"] < self.started:
-                return None
-            ages.append(self._clock() - beat["t"])
+        ranks have beaten)."""
+        ages = self._rank_ages(beats)
+        if any(a is None for a in ages):
+            return None  # not all ranks beating yet — grace period
         return max(ages) if ages else None
 
     @staticmethod
@@ -199,22 +208,37 @@ class GangMonitor:
         ``{"kind": "crashed"|"stalled", ...}``.  ``kind`` is None-equivalent
         ("done") when every child exited 0.  Stall verdicts carry the last
         known ``last_step``/``steps_per_sec`` so the launcher's log shows
-        where progress stopped, not just that it did."""
+        where progress stopped, not just that it did.
+
+        Failure verdicts also carry ``dead_ranks`` — the ranks CLASSIFIED
+        dead (a nonzero exit, or beats stopped past the timeout), never
+        merely slow (a slow rank keeps beating, its ``steps_per_sec`` just
+        drops) — the eviction policy's input: the supervisor shrinks the
+        gang to the survivors instead of restarting at full width and dying
+        again on the same bad host."""
         codes = [p.poll() for p in self.procs]
         if any(c is not None and c != 0 for c in codes):
-            return {"kind": "crashed",
-                    "codes": codes}
+            return {"kind": "crashed", "codes": codes,
+                    "dead_ranks": [i for i, c in enumerate(codes)
+                                   if c is not None and c != 0]}
         if all(c == 0 for c in codes):
             return {"kind": "done", "codes": codes}
         beats = self._read_beats()
+        ages = self._rank_ages(beats)
         age = self._heartbeat_age(beats)
         if age is not None and age > self.stall_timeout:
             return {"kind": "stalled", "stalest_beat_s": round(age, 1),
-                    "codes": codes, **self._progress(beats)}
+                    "codes": codes,
+                    "dead_ranks": [i for i, a in enumerate(ages)
+                                   if a is not None and a > self.stall_timeout],
+                    **self._progress(beats)}
         # also treat "no rank ever beat within the timeout" (e.g. rendezvous
-        # deadlock at startup) as a stall
+        # deadlock at startup) as a stall; ranks that never produced a beat
+        # count as dead alongside any whose beats went stale
         if age is None and (self._clock() - self.started) > 4 * self.stall_timeout:
             return {"kind": "stalled", "stalest_beat_s": None, "codes": codes,
+                    "dead_ranks": [i for i, a in enumerate(ages)
+                                   if a is None or a > self.stall_timeout],
                     **self._progress(beats)}
         return None
 
@@ -228,3 +252,91 @@ class GangMonitor:
                 time.sleep(0.1)
             if p.poll() is None:
                 p.kill()
+
+
+class GangSupervisor:
+    """Degrade-don't-die gang supervision: relaunch, evict, back off.
+
+    The launcher-side policy loop over :class:`GangMonitor` verdicts —
+    extracted from the spawn entrypoint so the eviction/backoff/budget
+    logic is unit-testable with fake processes and an injected clock:
+
+    - **restart** the whole gang from the newest snapshot on any failure
+      (SPMD collectives cannot absorb a lone replacement rank);
+    - **evict** when the verdict names dead ranks (crashed, or beats
+      stopped — never merely slow): the next incarnation launches at the
+      surviving width and the workers' elastic-width resume remaps the
+      data position (``Trainer._remap_elastic_width``).  A verdict that
+      condemns the ENTIRE gang (startup rendezvous wedge, whole-gang
+      stall) restarts at full width — there is no survivor set to degrade
+      to, and the cause is usually transient;
+    - **capped exponential backoff** between restarts (a flapping host
+      must not hot-loop the launcher into the coordinator);
+    - **restart budget**: after ``max_restarts`` failures the supervisor
+      gives up with the final verdict on stderr.
+
+    ``launch(width)`` must return the new gang's process list; ``sleep``/
+    ``clock`` are injectable for tests.
+    """
+
+    def __init__(self, launch, output_dir: str, num_processes: int, *,
+                 stall_timeout: float = 300.0, max_restarts: int = 2,
+                 shrink: bool = True, min_processes: int = 1,
+                 backoff: float = 1.0, backoff_cap: float = 30.0,
+                 poll_interval: float = 0.2,
+                 clock: Callable[[], float] = time.time,
+                 sleep: Callable[[float], None] = time.sleep,
+                 log: Optional[Callable[[str], None]] = None):
+        self.launch = launch
+        self.output_dir = output_dir
+        self.num_processes = int(num_processes)
+        self.stall_timeout = stall_timeout
+        self.max_restarts = int(max_restarts)
+        self.shrink = bool(shrink)
+        self.min_processes = max(1, int(min_processes))
+        self.backoff = float(backoff)
+        self.backoff_cap = float(backoff_cap)
+        self.poll_interval = poll_interval
+        self._clock = clock
+        self._sleep = sleep
+        self._log = log if log is not None else (
+            lambda msg: print(msg, file=sys.stderr))
+        self.restarts = 0
+        self.width = self.num_processes
+        self.widths_launched: List[int] = []
+
+    def run(self) -> int:
+        while True:
+            self.widths_launched.append(self.width)
+            procs = self.launch(self.width)
+            mon = GangMonitor(procs, self.output_dir, self.width,
+                              stall_timeout=self.stall_timeout,
+                              clock=self._clock)
+            verdict = None
+            while verdict is None:
+                self._sleep(self.poll_interval)
+                verdict = mon.poll()
+            if verdict["kind"] == "done":
+                return 0
+            mon.kill_gang()
+            if self.restarts >= self.max_restarts:
+                self._log(f"[elastic] giving up after {self.restarts} "
+                          f"restarts: {verdict}")
+                return 1
+            self.restarts += 1
+            dead = verdict.get("dead_ranks") or []
+            if self.shrink and dead and len(dead) < self.width:
+                new_width = max(self.min_processes, self.width - len(dead))
+                if new_width != self.width:
+                    self._log(f"[elastic] evicting dead rank(s) {dead} — "
+                              f"resuming at width {new_width} (was "
+                              f"{self.width})")
+                    self.width = new_width
+            delay = min(self.backoff_cap,
+                        self.backoff * (2 ** (self.restarts - 1)))
+            self._log(f"[elastic] gang failure {verdict} — restart "
+                      f"{self.restarts}/{self.max_restarts} at width "
+                      f"{self.width} from latest snapshot (backoff "
+                      f"{delay:.1f}s)")
+            if delay > 0:
+                self._sleep(delay)

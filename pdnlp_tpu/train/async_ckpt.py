@@ -1,0 +1,165 @@
+"""Async checkpoint publishing — the step loop pays device→host only.
+
+The synchronous save path (``checkpoint.save_state``) does three things in
+the caller's thread: consolidate the state to host (device→host copy — a
+barrier on every previously dispatched step, unavoidable for a consistent
+snapshot), serialize it to msgpack, and write + fsync + rename the file.
+Only the FIRST belongs in the step loop; on preemptible multi-host runs the
+serialize+IO tail is pure stall — at resume cadences worth having (tens of
+steps) it shows up directly in the ``ckpt_save`` phase of the step
+breakdown.
+
+:class:`AsyncCheckpointer` splits the save at exactly that line:
+
+- the caller (the trainer's ``ckpt_save`` span) produces a host snapshot
+  via :func:`checkpoint.snapshot` — collective, so EVERY process runs it —
+  and hands it to :meth:`submit`, which returns immediately;
+- one daemon writer thread serializes and crash-atomically publishes
+  (tmp + rename + checksum manifest, ``checkpoint.publish``) off the loop;
+- **double-buffered, at most one save in flight**: the writer processes one
+  publish at a time; while it writes, at most one NEWER snapshot per path
+  waits in the pending slot — a third submit for the same path replaces the
+  waiting one (latest wins; the superseded snapshot was about to be
+  stale anyway).  Host memory is therefore bounded at two snapshots, and
+  the step loop never blocks on disk;
+- :meth:`wait` drains everything (end of training — durability work that
+  must count toward the reported runtime) and re-raises the first writer
+  error; a failed write also surfaces LOUDLY on the next :meth:`submit`
+  instead of rotting silently.
+
+Only process 0 enqueues writes (the same rank-0-writes split as the sync
+path); the snapshot handed in is plain host numpy, so the writer thread
+never touches a device.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class AsyncCheckpointer:
+    def __init__(self, process_index: Optional[int] = None):
+        if process_index is None:
+            import jax
+
+            process_index = jax.process_index()
+        self.process_index = int(process_index)
+        self._cond = threading.Condition()
+        # path -> (kind, payload, meta); FIFO across paths, latest-wins
+        # per path.  kind "msgpack" = a checkpoint.snapshot tree to
+        # serialize+publish; "json" = a small sidecar object for
+        # write_json_atomic (the -best.json tracker rides the writer too —
+        # no sync disk IO sneaks back into the step loop)
+        self._pending: "collections.OrderedDict[str, Tuple[str, Any, Optional[Dict]]]" \
+            = collections.OrderedDict()
+        self._in_flight: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
+        self._errors: List[Tuple[str, BaseException]] = []
+        self.submitted = 0
+        self.published = 0
+        self.superseded = 0
+
+    # ------------------------------------------------------------ submitting
+    def submit(self, path: str, host_tree: Any,
+               meta: Optional[Dict] = None) -> None:
+        """Enqueue one crash-atomic publish of ``host_tree`` (a
+        ``checkpoint.snapshot`` result) to ``path``.  Returns immediately;
+        never blocks on serialization or disk.  Non-zero ranks no-op (the
+        collective snapshot already ran in the caller).  Raises the writer's
+        pending error, if any, before enqueuing — a broken disk must fail
+        the run at the next save, not at the end."""
+        self._enqueue(path, "msgpack", host_tree, meta)
+
+    def submit_json(self, path: str, obj: Any) -> None:
+        """Enqueue a small crash-atomic JSON sidecar write (e.g. the
+        ``-best.json`` tracker) on the same writer — even a few-byte fsync
+        does not belong on the step loop."""
+        self._enqueue(path, "json", obj, None)
+
+    def _enqueue(self, path: str, kind: str, payload: Any,
+                 meta: Optional[Dict]) -> None:
+        self._raise_pending_error()
+        if self.process_index != 0:
+            return
+        with self._cond:
+            if path in self._pending:
+                self.superseded += 1
+                del self._pending[path]  # re-insert at FIFO tail
+            self._pending[path] = (kind, payload, meta)
+            self.submitted += 1
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="async-ckpt-writer", daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+
+    # --------------------------------------------------------------- writer
+    def _run(self) -> None:
+        from flax import serialization
+
+        from pdnlp_tpu.train import checkpoint as ckpt
+
+        while True:
+            with self._cond:
+                while not self._pending:
+                    self._cond.wait()
+                path, (kind, payload, meta) = self._pending.popitem(last=False)
+                self._in_flight = path
+            try:
+                if kind == "json":
+                    ckpt.write_json_atomic(path, payload)
+                else:
+                    ckpt.publish(path, serialization.to_bytes(payload),
+                                 meta=meta)
+                with self._cond:
+                    self.published += 1
+            except BaseException as e:  # surfaced at next submit/wait
+                with self._cond:
+                    self._errors.append((path, e))
+            finally:
+                with self._cond:
+                    self._in_flight = None
+                    self._cond.notify_all()
+
+    # ---------------------------------------------------------------- waits
+    @property
+    def in_flight(self) -> bool:
+        with self._cond:
+            return self._in_flight is not None or bool(self._pending)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted save is published (or ``timeout``
+        seconds elapse — returns False, nothing is cancelled).  Re-raises
+        the first writer error once fully drained."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._pending or self._in_flight is not None:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        self._raise_pending_error()
+        return True
+
+    def _raise_pending_error(self) -> None:
+        with self._cond:
+            if not self._errors:
+                return
+            errors, self._errors = self._errors, []
+        # every failed path is named (a disk-full can take out the main
+        # snapshot AND its -best sidecar before anyone looks); the first
+        # failure is chained as the cause
+        raise RuntimeError(
+            "async checkpoint publish failed for "
+            + ", ".join(f"{p!r} ({type(e).__name__}: {e})"
+                        for p, e in errors)) from errors[0][1]
+
+    def stats(self) -> Dict[str, int]:
+        with self._cond:
+            return {"submitted": self.submitted, "published": self.published,
+                    "superseded": self.superseded,
+                    "errors": len(self._errors)}

@@ -110,16 +110,35 @@ def build_parallel_trainer(
     return trainer, train_loader, dev_loader
 
 
-def run_parallel(args: Args, **strategy) -> float:
-    """Train + test; returns wall-clock minutes (the north-star metric)."""
+def _try_resume(trainer, args: Args) -> None:
+    """Restore the newest resume snapshot when one exists.  Same-width
+    restores continue bitwise; a snapshot saved at a different data-
+    parallel width reshards onto this mesh and remaps the data position
+    (``Trainer.load_resume``/``_remap_elastic_width``).  A snapshot whose
+    file AND retained previous are both corrupt degrades to a fresh start
+    with a loud warning — for an elastic gang, re-training beats
+    crash-looping the supervisor's restart budget away."""
     import os
 
-    trainer, train_loader, dev_loader = build_parallel_trainer(args, **strategy)
-    if args.resume_from and os.path.exists(args.resume_path()):
-        # elastic restart path: continue bitwise from the latest snapshot
+    from pdnlp_tpu.train import checkpoint as ckpt
+
+    if not (args.resume_from and os.path.exists(args.resume_path())):
+        return
+    try:
         trainer.load_resume(args.resume_path())
-        rank0_print(f"resumed from {args.resume_path()} at step "
-                    f"{int(jax.device_get(trainer.state['step']))}")
+    except ckpt.CorruptCheckpointError as e:
+        rank0_print(f"WARNING: resume snapshot unusable ({e}) — no valid "
+                    "previous snapshot retained either; starting from "
+                    "scratch")
+        return
+    rank0_print(f"resumed from {args.resume_path()} at step "
+                f"{int(jax.device_get(trainer.state['step']))}")
+
+
+def run_parallel(args: Args, **strategy) -> float:
+    """Train + test; returns wall-clock minutes (the north-star metric)."""
+    trainer, train_loader, dev_loader = build_parallel_trainer(args, **strategy)
+    _try_resume(trainer, args)
     minutes = trainer.train(train_loader, dev_loader)
     result = trainer.test(dev_loader)
     rank0_print(f"test loss：{result['loss']:.6f} accuracy：{result['accuracy']:.4f}")
@@ -246,13 +265,8 @@ def build_pipeline_trainer(args: Args, mesh=None):
 
 def run_pipeline(args: Args) -> float:
     """Train + test on the pipeline path; returns wall-clock minutes."""
-    import os
-
     trainer, train_loader, dev_loader = build_pipeline_trainer(args)
-    if args.resume_from and os.path.exists(args.resume_path()):
-        trainer.load_resume(args.resume_path())
-        rank0_print(f"resumed from {args.resume_path()} at step "
-                    f"{int(jax.device_get(trainer.state['step']))}")
+    _try_resume(trainer, args)
     minutes = trainer.train(train_loader, dev_loader)
     result = trainer.test(dev_loader)
     rank0_print(f"test loss：{result['loss']:.6f} accuracy：{result['accuracy']:.4f}")

@@ -107,6 +107,19 @@ class Trainer:
         self.trace_summary = None
         self.best_accuracy = 0.0
         self._best_params = None  # device-held copy; written once at end
+        # async resume-snapshot writer (train/async_ckpt.py): the in-loop
+        # ckpt_save span pays the device->host snapshot only; serialization
+        # + crash-atomic publish ride this writer's thread.  Built lazily
+        # on the first in-loop save (--ckpt_async, default on); drained
+        # before train() reports its runtime.
+        self._ckpt_writer = None
+        # len(train_loader) of the active train() call — stamped into every
+        # resume snapshot's manifest meta so a restart on a DIFFERENT
+        # data-parallel width can remap the saved step counter onto its own
+        # steps-per-epoch (elastic-width resume)
+        self._steps_per_epoch = None
+        # manifest meta of the snapshot load_resume restored (None = fresh)
+        self._restored_meta = None
         # (minutes-since-train-start, dev accuracy) per in-loop eval: the
         # time-to-accuracy record bench.py reports (minutes_to_target)
         self.eval_history: list = []
@@ -254,10 +267,12 @@ class Trainer:
         hooks = hooks or LoopHooks()
         total_step = len(train_loader) * args.epochs
         gstep = 0
+        self._steps_per_epoch = len(train_loader)
         # fast-forward: a restored state carries the step it was saved at;
         # the sampler is a seeded permutation, so skipping exactly that many
         # batches replays the identical remaining stream (bitwise resume)
         start_step = int(jax.device_get(self.state["step"]))
+        start_step = self._remap_elastic_width(start_step, len(train_loader))
         if start_step > total_step:
             raise ValueError(
                 f"restored state is at step {start_step} but this "
@@ -353,6 +368,14 @@ class Trainer:
                             "fuse_steps the snapshot was saved under, or 1")
                     if fault_step and start_step == 0 and gstep >= fault_step \
                             and jax.process_index() == fault_proc:
+                        if os.environ.get("PDNLP_FAULT_KIND") == "sigkill":
+                            # the preemption shape: no atexit, no stdio
+                            # flush, no collective teardown — peers wedge
+                            # in their next collective until the gang
+                            # supervisor notices the corpse
+                            import signal
+
+                            os.kill(os.getpid(), signal.SIGKILL)
                         os._exit(13)
                     # bucket attr on the dispatch/block spans: the obs
                     # breakdown splits step phases per token width, so a
@@ -402,8 +425,11 @@ class Trainer:
                             steps_per_sec=detector.steps_per_sec
                             if detector is not None else None)
                     if resume_every and gstep // resume_every != prev // resume_every:
+                        # async (default): the span covers the device->host
+                        # snapshot + enqueue only — serialization and disk
+                        # ride the writer thread (drained in ckpt_wait)
                         with tr.span("ckpt_save", step=gstep):
-                            self.save_resume(args.resume_path())
+                            self._snapshot_resume(args.resume_path())
                     if gstep // args.log_every != prev // args.log_every:
                         if pending is not None:  # print the *previous* line's loss:
                             e, s, l = pending     # it is done by now — no sync stall
@@ -444,10 +470,26 @@ class Trainer:
             if last_loss is not None:
                 float(jax.device_get(last_loss))
             jax.block_until_ready(self.state["params"])
+            # durability drain: every in-flight async snapshot must be
+            # published before the run reports its runtime (a preempted
+            # host loses unflushed saves; a finished run must not).  Off
+            # the step loop by construction — its own ckpt_wait phase, so
+            # the in-loop ckpt_save p95 budget stays honest.
+            if self._ckpt_writer is not None:
+                with tr.span("ckpt_wait", step=gstep):
+                    self._ckpt_writer.wait()
             profiler.close()
         finally:
             if breakdown is not None:
                 tr.remove_listener(breakdown.feed)
+            if self._ckpt_writer is not None:
+                # exception path: best-effort drain (bounded) so the newest
+                # snapshot survives the failure; errors here must not mask
+                # the original exception
+                try:
+                    self._ckpt_writer.wait(timeout=60.0)
+                except Exception:
+                    pass
         if breakdown is not None:
             from pdnlp_tpu.obs import format_table
 
@@ -508,33 +550,129 @@ class Trainer:
         ckpt.save_params(path, {"params": self._eval_params()})
 
     # ---------------------------------------------------------------- resume
+    def _resume_meta(self) -> Dict:
+        """Manifest meta stamped on every resume snapshot: the saved step
+        and (when a train() is active) this width's steps-per-epoch — what
+        an elastic restart at a DIFFERENT data-parallel width needs to
+        remap the data position."""
+        meta: Dict = {"step": int(jax.device_get(self.state["step"]))}
+        if self._steps_per_epoch:
+            meta["steps_per_epoch"] = int(self._steps_per_epoch)
+        return meta
+
+    def _resume_writer(self):
+        """The lazily built async snapshot writer, or None when the run
+        opted back into synchronous saves (``--ckpt_async false``)."""
+        if not getattr(self.args, "ckpt_async", True):
+            return None
+        if self._ckpt_writer is None:
+            from pdnlp_tpu.train.async_ckpt import AsyncCheckpointer
+
+            self._ckpt_writer = AsyncCheckpointer()
+        return self._ckpt_writer
+
+    def _snapshot_resume(self, path: str) -> None:
+        """The in-loop resume snapshot: device→host copy here (inside the
+        caller's ``ckpt_save`` span), serialization + crash-atomic publish
+        on the async writer's thread — the step loop never blocks on disk,
+        and at most one save is in flight (``train/async_ckpt.py``).
+        ``--ckpt_async false`` falls back to the synchronous
+        :meth:`save_resume`."""
+        writer = self._resume_writer()
+        if writer is None:
+            self.save_resume(path)
+            return
+        meta = self._resume_meta()
+        writer.submit(path, ckpt.snapshot(self.state), meta=meta)
+        if self._best_params is not None:
+            writer.submit(path + "-best", ckpt.snapshot(self._best_params))
+            writer.submit_json(path + "-best.json",
+                               {"best_accuracy": self.best_accuracy})
+
     def save_resume(self, path: str) -> None:
         """Full mid-training snapshot: params + optimizer moments + step +
-        RNG.  The reference cannot resume (``SURVEY.md`` §5: no optimizer
-        state saving anywhere); this framework can, bitwise.
+        RNG, published crash-atomically with a checksum manifest.  The
+        reference cannot resume (``SURVEY.md`` §5: no optimizer state
+        saving anywhere); this framework can, bitwise.
 
         The best-of-epoch tracker rides along in sidecar files (``<path>``
         + ``-best``/``-best.json``) so an elastic restart cannot regress the
         shipped best model to a later, worse eval."""
-        ckpt.save_state(path, self.state)
+        ckpt.save_state(path, self.state, meta=self._resume_meta())
         if self._best_params is not None:
             ckpt.save_params(path + "-best", {"params": self._best_params})
             if jax.process_index() == 0:
-                import json
-
-                with open(path + "-best.json", "w") as f:
-                    json.dump({"best_accuracy": self.best_accuracy}, f)
+                ckpt.write_json_atomic(path + "-best.json",
+                                       {"best_accuracy": self.best_accuracy})
 
     def load_resume(self, path: str) -> None:
-        restored = ckpt.load_state(path, self.state)
+        """Restore a resume snapshot onto the LIVE state's shardings.
+
+        The file always holds fully consolidated host arrays
+        (``checkpoint.save`` all-gathers shards before writing), so this is
+        consolidate-then-reshard by construction: whatever data-parallel
+        width and sharding mode the live state was built with —
+        including a width different from the one that saved the snapshot —
+        ``_put_like`` re-places every leaf (params AND Adam moments) onto
+        the live ``parallel/sharding.py`` specs.  A corrupt file falls back
+        to the retained previous snapshot (``checkpoint.read_verified``)
+        with a loud warning."""
+        raw, meta, used = ckpt.read_verified(path)
+        restored = ckpt.from_restored(raw, self.state, path=used)
         self.state = _put_like(restored, self.state)
+        self._restored_meta = dict(meta) if meta else {}
         if os.path.exists(path + "-best"):
-            best = ckpt.load_params(path + "-best", self.state["params"])
-            self._best_params = _put_like(best, self.state["params"])
-            with open(path + "-best.json") as f:
+            # sidecar corruption must not fail the restore: the MAIN state
+            # is already valid and adopted — degrade to fresh best-tracking
+            # with a loud warning instead of reporting "from scratch"
+            try:
+                best = ckpt.load_params(path + "-best", self.state["params"])
                 import json
 
-                self.best_accuracy = json.load(f)["best_accuracy"]
+                with open(path + "-best.json") as f:
+                    acc = json.load(f)["best_accuracy"]
+            except (ckpt.CorruptCheckpointError, OSError, ValueError,
+                    KeyError):
+                rank0_print(f"WARNING: {path}-best sidecar missing/corrupt "
+                            "— main state restored; best-accuracy tracking "
+                            "restarts from the restored weights")
+            else:
+                self._best_params = _put_like(best, self.state["params"])
+                self.best_accuracy = acc
+
+    def _remap_elastic_width(self, start_step: int, spe: int) -> int:
+        """Map a restored step counter onto THIS run's steps-per-epoch.
+
+        Same width (or fresh start): identity — resume stays bitwise.  A
+        snapshot saved under a different data-parallel width carries its
+        ``steps_per_epoch`` in the manifest meta; the data position then
+        continues by EPOCH FRACTION (ceil: examples the old optimizer
+        already consumed are never re-applied; at most one new-width
+        batch's worth of rows is skipped instead).  The on-device step
+        counter is rebased to the remapped value so subsequent snapshots,
+        fast-forward math, and log lines all speak this width's units.
+        Optimizer state (Adam moments + count) is restored exactly —
+        elastic resume changes the data layout, never the training math
+        already done."""
+        meta, self._restored_meta = (self._restored_meta or {}), None
+        old_spe = meta.get("steps_per_epoch")
+        if not start_step or not old_spe or old_spe == spe:
+            return start_step
+        remapped = -(-start_step * spe // old_spe)  # ceil
+        fuse = getattr(self.args, "fuse_steps", 1)
+        if self.multi_step is not None and fuse > 1:
+            # resume must land on a fused-group boundary (train() rejects
+            # interior steps); round up — same skip-don't-replay policy
+            remapped = -(-remapped // fuse) * fuse
+        rank0_print(
+            f"elastic resume: remapped step {start_step} (of {old_spe}/epoch "
+            f"at save time) -> {remapped} (of {spe}/epoch at this width); "
+            "data position continues by epoch fraction, optimizer state is "
+            "exact")
+        like = self.state["step"]
+        self.state["step"] = _put_like(
+            np.asarray(remapped, dtype=getattr(like, "dtype", np.int32)), like)
+        return remapped
 
     # ------------------------------------------------------------------- eval
     def _evaluate(self, loader, collect_preds: bool,

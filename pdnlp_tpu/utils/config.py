@@ -224,12 +224,33 @@ class Args:
     # --- failure detection / elastic restart (parallel/watchdog.py) ---
     resume_every: Optional[int] = None            # full-state snapshot every N steps
     resume_from: Optional[str] = None             # snapshot path, or "auto"
+    ckpt_async: bool = True                       # resume snapshots: device->
+                                                  # host copy in-loop, msgpack
+                                                  # + atomic publish on a
+                                                  # writer thread (train/
+                                                  # async_ckpt.py; at most
+                                                  # one save in flight).
+                                                  # false = synchronous save
+                                                  # back in the step loop
     heartbeat_interval: float = 0.0               # seconds; 0 = no heartbeat
     elastic: bool = False                         # spawn launcher: restart on failure
+    elastic_shrink: bool = True                   # evict DEAD ranks and
+                                                  # resume the gang at the
+                                                  # surviving width (the
+                                                  # degrade-don't-die
+                                                  # policy); false = always
+                                                  # restart at full width
+                                                  # (bitwise layout-matched
+                                                  # continuation)
+    min_processes: int = 1                        # never shrink the gang
+                                                  # below this width
     stall_timeout: float = 300.0                  # launcher stall detector
                                                   # (pre-first-beat grace is
                                                   # 4x this, covering compile)
     max_restarts: int = 2                         # gang restarts before giving up
+    restart_backoff: float = 1.0                  # seconds before restart 1;
+                                                  # doubles per restart
+    restart_backoff_cap: float = 30.0             # exponential backoff ceiling
 
     def replace(self, **kw) -> "Args":
         return dataclasses.replace(self, **kw)

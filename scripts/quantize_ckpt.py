@@ -49,11 +49,10 @@ def main(argv=None) -> int:
 
     out = ns.output or (ns.checkpoint.rsplit(".msgpack", 1)[0]
                         + ".int8.msgpack")
-    data = serialization.to_bytes(qparams)
-    tmp = out + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, out)  # atomic, like checkpoint.save
+    # crash-atomic + checksum manifest, like every other published
+    # checkpoint — a truncated artifact then fails loudly at load time
+    # instead of three layers later as an opaque msgpack error
+    ckpt.publish(out, serialization.to_bytes(qparams))
 
     in_bytes = os.path.getsize(ns.checkpoint)
     print(f"wrote {out}  ({in_bytes / 1e6:.1f} MB -> "

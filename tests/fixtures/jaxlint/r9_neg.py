@@ -1,0 +1,33 @@
+"""R9 negative: epoch-end saves, the async snapshot+submit idiom, and
+save-only / step-only loops."""
+from pdnlp_tpu.train import checkpoint as ckpt
+from pdnlp_tpu.train.async_ckpt import AsyncCheckpointer
+
+
+def epoch_end_save(train_step, state, loader, path):
+    for batch in loader:
+        state, m = train_step(state, batch)
+    ckpt.save_state(path, state)         # after the loop: one stall, once
+    return state
+
+
+def async_saves(train_step, state, loader, path):
+    writer = AsyncCheckpointer()
+    for batch in loader:
+        state, m = train_step(state, batch)
+        # snapshot-in-loop + submit IS the fix: device->host only, the
+        # writer thread pays serialization + publish
+        writer.submit(path, ckpt.snapshot(state))
+    writer.wait()
+    return state
+
+
+def save_only_loop(states, path):
+    for i, state in enumerate(states):   # no step dispatch: a batch
+        ckpt.save_params(path + str(i), state)  # export pass, not the loop
+
+
+def step_only_loop(train_step, state, loader):
+    for batch in loader:
+        state, m = train_step(state, batch)
+    return state

@@ -1,0 +1,23 @@
+"""R9 positive: synchronous checkpoint writes inside step loops."""
+from pdnlp_tpu.train import checkpoint as ckpt
+
+
+def epoch(train_step, state, loader, path):
+    for batch in loader:
+        state, m = train_step(state, batch)
+        ckpt.save_state(path, state)                   # line 8: module save
+    return state
+
+
+def rotate(train_step, state, loader, ckpt_dir):
+    for i, batch in enumerate(loader):
+        state, m = train_step(state, batch)
+        ckpt.save_params(ckpt_dir + str(i), state)     # line 15: params save
+    return state
+
+
+class Runner:
+    def run(self, loader, path):
+        while self.more():
+            self.state, m = self.multi_step(self.state, next(loader))
+            self.save_resume(path)                     # line 23: method save

@@ -49,6 +49,10 @@ def elastic_run(tmp_path_factory):
         [sys.executable, os.path.join(REPO, "multi-tpu-spawn-cls.py"),
          "--num_processes", "2", "--output_dir", str(out),
          "--elastic", "true", "--resume_every", "3", "--stall_timeout", "60",
+         # this module pins the BYTE-IDENTICAL same-layout contract, so the
+         # restart must keep the 2x4 layout: opt out of the default
+         # evict-and-shrink policy (tests/test_chaos.py covers eviction)
+         "--elastic_shrink", "false",
          *COMMON_ARGS],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=1200,
     )

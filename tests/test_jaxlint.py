@@ -141,6 +141,23 @@ def test_r8_hint_points_at_attn_impl():
     assert "--attn_impl" in f.hint
 
 
+def test_r9_blocking_ckpt_positive():
+    # module-resolved save_state (8), save_params (15), the trainer-style
+    # self.save_resume method call (23)
+    assert all_hits("r9_pos.py") == [("R9", 8), ("R9", 15), ("R9", 23)]
+
+
+def test_r9_blocking_ckpt_negative():
+    assert hits("r9_neg.py", "R9") == []
+
+
+def test_r9_hint_points_at_the_async_saver():
+    path = os.path.join(FIXTURES, "r9_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R9"][0]
+    assert "async_ckpt" in f.hint and "submit" in f.hint
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -151,7 +168,7 @@ def test_findings_carry_exact_location_and_hint():
 
 def test_rule_registry_complete():
     assert list(all_rules()) == ["R1", "R2", "R3", "R4", "R5", "R6", "R7",
-                                 "R8"]
+                                 "R8", "R9"]
 
 
 # -------------------------------------------------------------- suppressions

@@ -382,6 +382,71 @@ def test_diff_breakdowns_min_count_guards_amortized_phases():
     assert diff_breakdowns(base, cand)["regressions"] == ["h2d_put"]
 
 
+def test_diff_breakdowns_ckpt_save_budget_gate():
+    """The async-checkpointing contract as a trace gate: the CANDIDATE's
+    in-loop ckpt_save p95 is bounded ABSOLUTELY (independent of the base
+    trace — a regression vs an already-bloated base must still fail)."""
+    base = {"phases": {}}
+    cand = {"phases": {"ckpt_save": {"mean_sec": 0.004, "p95_sec": 0.009,
+                                     "count": 12}}}
+    ok = diff_breakdowns(base, cand, ckpt_save_budget=0.010)
+    assert ok["ckpt_save_budget"] == {"budget_sec": 0.010,
+                                     "cand_p95_sec": 0.009,
+                                     "exceeded": False}
+    assert ok["regressions"] == []
+    bad = diff_breakdowns(base, cand, ckpt_save_budget=0.005)
+    assert bad["ckpt_save_budget"]["exceeded"] is True
+    assert "ckpt_save(p95-budget)" in bad["regressions"]
+    # a trace with no saves passes vacuously (nothing to measure)
+    empty = diff_breakdowns(base, {"phases": {}}, ckpt_save_budget=0.005)
+    assert empty["ckpt_save_budget"]["exceeded"] is False
+    # the end-of-run drain (ckpt_wait) is NEVER the gated phase
+    drained = diff_breakdowns(base, {"phases": {
+        "ckpt_wait": {"mean_sec": 2.0, "p95_sec": 2.0, "count": 1}}},
+        ckpt_save_budget=0.005)
+    assert drained["regressions"] == []
+
+
+def test_trace_diff_cli_ckpt_save_budget_exit_code(tmp_path):
+    """End-to-end through trace_tpu.py diff: a trace whose in-loop
+    ckpt_save p95 busts the budget exits 1; a generous budget exits 0."""
+    import subprocess
+    import sys
+
+    from pdnlp_tpu.obs.export import write_jsonl
+
+    def trace(path, save_sec):
+        recs = []
+        t = 0.0
+        for i in range(1, 8):
+            recs.append({"name": "step_dispatch", "t0": t, "dur": 0.001,
+                         "tid": 0, "depth": 0})
+            recs.append({"name": "ckpt_save", "t0": t + 0.001,
+                         "dur": save_sec, "tid": 0, "depth": 0})
+            recs.append({"name": "device_block", "t0": t + 0.002,
+                         "dur": 0.01, "tid": 0, "depth": 0,
+                         "attrs": {"step": i}})
+            t += 0.02
+        write_jsonl(recs, str(path), process_index=0)
+
+    base, cand = tmp_path / "base.jsonl", tmp_path / "cand.jsonl"
+    trace(base, 0.002)
+    trace(cand, 0.002)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(budget):
+        return subprocess.run(
+            [sys.executable, os.path.join(repo, "trace_tpu.py"), "diff",
+             str(base), str(cand), "--ckpt_save_budget", str(budget)],
+            capture_output=True, text=True, env={**os.environ,
+                                                 "PYTHONPATH": repo})
+
+    assert run(0.010).returncode == 0
+    over = run(0.001)
+    assert over.returncode == 1
+    assert "OVER BUDGET" in over.stdout
+
+
 # ----------------------------------------------------------------- CLI paths
 
 def _write_trace(tmp_path, name, block_ms):
@@ -521,6 +586,7 @@ def test_tracing_overhead_smoke():
     assert traced < base * 2.0, (traced, base)
 
 
-def test_phase_vocabulary_is_the_documented_seven():
+def test_phase_vocabulary_is_the_documented_eight():
     assert PHASES == ("data_wait", "h2d_put", "step_dispatch",
-                      "device_block", "eval", "ckpt_save", "log")
+                      "device_block", "eval", "ckpt_save", "ckpt_wait",
+                      "log")

@@ -49,7 +49,8 @@ def cmd_diff(ns) -> int:
     base, cand = _summary(ns.base), _summary(ns.candidate)
     diff = diff_breakdowns(base, cand, threshold=ns.threshold,
                            min_mean_sec=ns.min_mean_sec,
-                           min_count=ns.min_count)
+                           min_count=ns.min_count,
+                           ckpt_save_budget=ns.ckpt_save_budget)
     if ns.json:
         print(json.dumps(diff, indent=2))
     else:
@@ -65,6 +66,14 @@ def cmd_diff(ns) -> int:
                   f"{am * 1e3 if am else float('nan'):>10.3f} "
                   f"{bm * 1e3 if bm else float('nan'):>10.3f} "
                   f"{f'{d:+.1%}' if d is not None else 'n/a':>8}{mark}")
+        budget = diff.get("ckpt_save_budget")
+        if budget is not None:
+            p95 = budget["cand_p95_sec"]
+            shown = (f"{p95 * 1e3:.3f}ms" if p95 is not None
+                     else "n/a (no saves in trace)")
+            print(f"ckpt_save p95 {shown} vs budget "
+                  f"{budget['budget_sec'] * 1e3:.3f}ms"
+                  + ("  << OVER BUDGET" if budget["exceeded"] else ""))
         impls = diff.get("impls")
         if impls and impls["changed"]:
             # a phase delta alongside this line is attributable: the two
@@ -110,6 +119,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="phases with fewer observations than this in "
                         "either trace are never flagged (1-2 samples of "
                         "an amortized upload are noise, not a trend)")
+    d.add_argument("--ckpt_save_budget", type=float, default=None,
+                   help="absolute bound (seconds) on the CANDIDATE trace's "
+                        "in-loop ckpt_save p95 — under the async "
+                        "checkpointer the phase measures device->host "
+                        "snapshot + enqueue only, so a p95 over budget "
+                        "means serialization/disk crept back onto the "
+                        "step loop; exit 1 when exceeded")
     d.add_argument("--json", action="store_true")
     d.set_defaults(fn=cmd_diff)
 
