@@ -202,6 +202,334 @@ def serve_smoke(argv) -> None:
                  f"(expected 0) — see {out_path}")
 
 
+def serve_load_smoke(argv) -> None:
+    """``--serve-load``: closed-loop SLO gate for the multi-replica router.
+
+    A Poisson arrival storm (``--serve_load_qps``, mixed lengths spanning
+    3 buckets) is driven through a :class:`ReplicaRouter` over
+    ``--serve_load_replicas`` engines while the smoke injects the failures
+    the router exists to survive:
+
+    - **mid-storm replica kill** (worker dies, beats stop — the SIGKILL
+      shape at replica granularity): the router must eject it, requeue its
+      queued + in-flight requests onto survivors, and — after the smoke
+      relaunches it — reintegrate it only after a fresh bucket warmup;
+    - **mid-storm rolling checkpoint swap**: one replica drained + swapped
+      at a time, under load, with ZERO post-warmup retraces;
+    - **an overload burst** (short deadlines, arrival >> service) that must
+      walk ALL admission tiers: backpressure waits, shed-lowest-slack, and
+      hard rejects, each recorded per tier.
+
+    Gates (non-zero exit on any violation): zero LOST accepted requests (a
+    request may succeed or deadline-fail, never vanish or surface a replica
+    error), p99 latency at the target QPS under ``--serve_load_p99_ms``,
+    zero post-warmup retraces across the pool, ejection-to-recovery under
+    ``--serve_load_recovery_s``, a completed rolling swap with zero
+    rollbacks, and every admission tier engaged during the burst.
+    Snapshot: ``results/serve_load_smoke.json``.  Deterministic and
+    CPU-safe like ``--serve`` (synthesized texts, seeded arrivals).
+    """
+    import random
+    import tempfile
+    import threading
+    import time
+
+    import jax
+
+    from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
+    from pdnlp_tpu.serve import (
+        InferenceEngine, LoadShedError, QueueFullError, ReplicaRouter,
+    )
+    from pdnlp_tpu.serve.batcher import DeadlineExceeded
+    from pdnlp_tpu.train import checkpoint as ckpt_mod
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, n_requests = pop_cli_flag(argv, "--serve_load_requests", 240, int)
+    argv, qps = pop_cli_flag(argv, "--serve_load_qps", 120.0, float)
+    argv, n_replicas = pop_cli_flag(argv, "--serve_load_replicas", 3, int)
+    argv, p99_budget = pop_cli_flag(argv, "--serve_load_p99_ms", 1500.0,
+                                    float)
+    argv, recovery_bound = pop_cli_flag(argv, "--serve_load_recovery_s",
+                                        20.0, float)
+    argv, deadline_ms = pop_cli_flag(argv, "--serve_load_deadline_ms",
+                                     8000.0, float)
+    argv, out_path = pop_cli_flag(
+        argv, "--serve_load_out",
+        os.path.join("results", "serve_load_smoke.json"))
+    # bert-tiny default (like --kernels): the gate measures ROUTER behavior
+    # — ejection, requeue, tiers, swap — not model throughput; a bigger
+    # model only slows the chaos loop without sharpening any assertion
+    args = parse_cli(argv, base=Args(model="bert-tiny"))
+
+    # deterministic mixed-length traffic across the 32/64/128 buckets
+    chars = "天地人你我他好坏大小上下来去爱恨喜怒哀乐高兴悲伤讨厌愤怒"
+    rng = random.Random(args.seed)
+    lengths = [10, 24, 48, 60, 100, 120]
+    texts = ["".join(rng.choice(chars)
+                     for _ in range(lengths[i % len(lengths)]))
+             for i in range(n_requests)]
+    if os.path.exists(args.data_path) or os.path.exists(args.vocab_path):
+        from pdnlp_tpu.data.tokenizer import get_or_build_vocab
+
+        tok = WordPieceTokenizer(get_or_build_vocab(args))
+    else:
+        tok = WordPieceTokenizer(build_vocab(texts, size=256))
+
+    buckets = (32, 64, 128)
+    batch_size = 8
+    max_queue = 64
+    # one mesh slice per replica when the host has the devices; otherwise
+    # independent plain-jit engines (the CPU-test shape)
+    devices = list(jax.devices())
+    per = len(devices) // n_replicas
+    groups = [None] * n_replicas
+    if per >= 1 and len(devices) >= n_replicas > 1:
+        from pdnlp_tpu.parallel import make_mesh
+
+        groups = [make_mesh(devices=devices[i * per:(i + 1) * per])
+                  for i in range(n_replicas)]
+
+    def factory(index: int) -> InferenceEngine:
+        return InferenceEngine(args, tokenizer=tok, mesh=groups[index])
+
+    engines = [factory(i) for i in range(n_replicas)]
+    ckpt_path = ckpt_mod.latest(args.output_dir)
+    if ckpt_path:
+        try:
+            for e in engines:
+                e.load_checkpoint(ckpt_path)
+        except Exception as exc:  # noqa: BLE001 — init weights are fine
+            print(f"checkpoint {ckpt_path} not loadable ({exc}); "
+                  "serving init weights", file=sys.stderr)
+            ckpt_path = None
+    router = ReplicaRouter(
+        engines, engine_factory=factory, buckets=buckets,
+        max_batch_size=batch_size, max_wait_ms=5.0, max_queue=max_queue,
+        backpressure_wait_ms=10.0, default_deadline_ms=deadline_ms,
+        stall_timeout=2.0, poll_interval=0.05, checkpoint_path=ckpt_path)
+    router.start()
+    if not router.wait_ready(600):
+        sys.exit("serve-load smoke FAILED: replicas never finished warmup")
+
+    # the rolling-swap artifact: the pool's own weights, re-published
+    # through the manifest path (same shapes -> swap must not retrace)
+    swap_dir = tempfile.mkdtemp(prefix="pdnlp-serve-load-")
+    swap_path = os.path.join(swap_dir, "swap-cls.msgpack")
+    ckpt_mod.save_params(swap_path,
+                         {"params": jax.device_get(router.engine(0).params)})
+
+    victim = n_replicas - 1
+    kill_at, swap_at, relaunch_at = (n_requests // 3, n_requests // 2,
+                                     (2 * n_requests) // 3)
+    outcomes = {"ok": 0, "deadline": 0, "shed": 0, "rejected": 0,
+                "lost": 0}
+    swap_report: dict = {}
+    swap_thread = None
+    futs = []
+    storm_t0 = time.monotonic()
+    t_next = time.monotonic()
+    for i in range(n_requests):
+        if i == kill_at:
+            # strand real work on the victim: a quick unpaced burst fills
+            # every replica's queues, THEN the kill lands — the zero-lost
+            # gate must cover requeued + retried requests, not an idle
+            # replica's no-op death.  Guarded like every other submit: on
+            # a slow host the backlog may already sit in the shed/reject
+            # band, and that is an outcome to record, not a crash
+            for j in range(2 * batch_size * n_replicas):
+                try:
+                    futs.append(router.submit(texts[(i + j) % len(texts)]))
+                except LoadShedError:
+                    outcomes["shed"] += 1
+                except QueueFullError:
+                    outcomes["rejected"] += 1
+            router.kill_replica(victim, "crash")
+        if i == relaunch_at:
+            # the monitor needs one poll tick to classify the crash; the
+            # relaunch API refuses to replace a live replica
+            t_eject = time.monotonic() + 5.0
+            while router.states[victim] != "ejected" \
+                    and time.monotonic() < t_eject:
+                time.sleep(0.01)
+            router.relaunch(victim)
+        if i == swap_at:
+            # the rolling swap drains replicas one at a time — it must
+            # run UNDER load, so it rides its own thread while arrivals
+            # keep coming
+            swap_thread = threading.Thread(
+                target=lambda: swap_report.update(
+                    router.swap_checkpoint(swap_path)))
+            swap_thread.start()
+        t_next += rng.expovariate(qps)  # Poisson arrivals at the target QPS
+        time.sleep(max(0.0, t_next - time.monotonic()))
+        try:
+            futs.append(router.submit(texts[i]))
+        except LoadShedError:
+            outcomes["shed"] += 1
+        except QueueFullError:
+            outcomes["rejected"] += 1
+    for f in futs:
+        try:
+            f.result(timeout=60)
+            outcomes["ok"] += 1
+        except DeadlineExceeded:
+            outcomes["deadline"] += 1
+        except LoadShedError:  # accepted, then shed while queued once the
+            outcomes["shed"] += 1  # pool hit the shed band — by design
+        except Exception:  # noqa: BLE001 — replica error/timeout = LOST
+            outcomes["lost"] += 1
+    if swap_thread is not None:
+        swap_thread.join(timeout=60)
+    storm_elapsed = time.monotonic() - storm_t0
+    achieved_qps = len(futs) / storm_elapsed
+    p99 = router.metrics.request_latency_ms.percentile(99)
+    # the relaunched replica's warmup (fresh engine -> fresh compiles) may
+    # outlast the storm tail; reintegration must COMPLETE before the gates
+    # read recovery/reintegration counters
+    if not router.wait_ready(300):
+        sys.exit("serve-load smoke FAILED: relaunched replica never "
+                 "finished its reintegration warmup")
+    recovery = router.metrics.recovery_sec.snapshot()
+
+    # ---- overload burst: every admission tier must engage + record ----
+    burst_n = max_queue * 3
+    burst_outcomes = {"ok": 0, "deadline": 0, "shed": 0, "rejected": 0,
+                      "lost": 0}
+    burst_lock = threading.Lock()
+
+    def burster(k: int) -> None:
+        fs = []
+        for j in range(burst_n // 3):
+            # every 3rd arrival carries a deadline under the shed tier's
+            # slack floor: once the pool is in the shed band, those are
+            # the lowest-slack requests and must be shed first
+            dl = 8.0 if j % 3 == 0 else 150.0
+            try:
+                fs.append(router.submit(texts[(k + j) % len(texts)],
+                                        deadline_ms=dl))
+            except LoadShedError:
+                with burst_lock:
+                    burst_outcomes["shed"] += 1
+            except QueueFullError:
+                with burst_lock:
+                    burst_outcomes["rejected"] += 1
+        for f in fs:
+            try:
+                f.result(timeout=30)
+                key = "ok"
+            except DeadlineExceeded:
+                key = "deadline"
+            except LoadShedError:
+                key = "shed"
+            except Exception:  # noqa: BLE001
+                key = "lost"
+            with burst_lock:
+                burst_outcomes[key] += 1
+
+    bursters = [threading.Thread(target=burster, args=(k,))
+                for k in range(3)]
+    for t in bursters:
+        t.start()
+    for t in bursters:
+        t.join(timeout=120)
+
+    snap = router.snapshot()
+    router.stop(drain=False)
+    adm = snap["router"]["admission"]
+    retraces_post = router.retraces_post_warmup
+    result = {
+        "metric": "serve_load_smoke",
+        "requests": n_requests,
+        "target_qps": qps,
+        "achieved_qps": round(achieved_qps, 1),
+        "replicas": n_replicas,
+        "device_groups": [g is not None for g in groups],
+        "buckets": list(buckets),
+        "batch_size": batch_size,
+        "max_queue": max_queue,
+        "deadline_ms": deadline_ms,
+        "storm": outcomes,
+        "latency_ms_p50":
+            router.metrics.request_latency_ms.percentile(50),
+        "latency_ms_p99": p99,
+        "p99_budget_ms": p99_budget,
+        "kill": {
+            "victim": victim,
+            "ejections": snap["router"]["ejections_total"],
+            "requeued": snap["router"]["requeued_total"],
+            "retries": snap["router"]["retries_total"],
+            "reintegrations": snap["router"]["reintegrations_total"],
+            "recovery_sec_max": recovery["max"],
+            "recovery_bound_s": recovery_bound,
+        },
+        "swap": {
+            "swapped": swap_report.get("swapped"),
+            "rolled_back": swap_report.get("rolled_back"),
+            "skipped": swap_report.get("skipped"),
+        },
+        "retraces_post_warmup": retraces_post,
+        "burst": {"requests": 3 * (burst_n // 3), **burst_outcomes},
+        "admission": adm,
+        "checkpoint": ckpt_path,
+        "model": args.model,
+        "serve_dtype": router.engine(0).dtype_label,
+        "devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+        "metrics": snap,
+    }
+
+    failures = []
+    if outcomes["lost"] or burst_outcomes["lost"]:
+        failures.append(
+            f"LOST accepted requests: storm {outcomes['lost']} / burst "
+            f"{burst_outcomes['lost']} (every accepted request must "
+            "complete or deadline-fail)")
+    if outcomes["deadline"] + outcomes["shed"] + outcomes["rejected"] \
+            > n_requests // 10:
+        failures.append(
+            f"storm shed too much at the target QPS: {outcomes} (the pool "
+            "must absorb the configured load, not shed it)")
+    if p99 is not None and p99 > p99_budget:
+        failures.append(f"p99 latency {p99:.1f}ms over the "
+                        f"{p99_budget:.0f}ms budget at {qps} QPS")
+    if retraces_post != 0:
+        failures.append(f"{retraces_post} post-warmup retraces (expected "
+                        "0 across kill, relaunch and rolling swap)")
+    if snap["router"]["ejections_total"] < 1 \
+            or snap["router"]["reintegrations_total"] < 1:
+        failures.append("the killed replica was not ejected+reintegrated "
+                        f"(ejections {snap['router']['ejections_total']}, "
+                        "reintegrations "
+                        f"{snap['router']['reintegrations_total']})")
+    if snap["router"]["requeued_total"] \
+            + snap["router"]["retries_total"] < 1:
+        failures.append("the kill stranded no requests — requeue/retry "
+                        "was never exercised (requeued "
+                        f"{snap['router']['requeued_total']}, retries "
+                        f"{snap['router']['retries_total']})")
+    if recovery["count"] < 1 or (recovery["max"] or 0) > recovery_bound:
+        failures.append(f"ejection->recovery {recovery['max']}s outside "
+                        f"the {recovery_bound}s bound")
+    if len(swap_report.get("swapped") or []) < max(1, n_replicas - 1) \
+            or swap_report.get("rolled_back"):
+        failures.append(f"rolling swap incomplete: {swap_report}")
+    for tier in ("backpressure_waits", "shed", "rejected"):
+        if adm[tier] < 1:
+            failures.append(f"admission tier {tier!r} never engaged "
+                            f"during the burst ({adm})")
+
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, out_path)
+    print(json.dumps({k: v for k, v in result.items() if k != "metrics"}))
+    if failures:
+        sys.exit("serve-load smoke FAILED:\n  - " + "\n  - ".join(failures)
+                 + f"\n  see {out_path}")
+
+
 def _smoke_model(args, vocab_size):
     """Mesh + sharded DP model + jitted step + put — the ONE model/mesh
     configuration every bench smoke measures against (``--pipeline``,
@@ -1374,6 +1702,14 @@ def main() -> None:
         # kernel_smoke.json) — like --pipeline/--length, not an Args knob
         argv.remove("--kernels")
         return kernel_smoke(argv)
+    if "--serve-load" in argv or "--serve_load" in argv:
+        # closed-loop router SLO gate (results/serve_load_smoke.json):
+        # Poisson storm + mid-storm replica kill + rolling swap + overload
+        # burst over N replica engines — like --serve, an intercept
+        for flag in ("--serve-load", "--serve_load"):
+            if flag in argv:
+                argv.remove(flag)
+        return serve_load_smoke(argv)
     if "--serve" in argv:
         # No pretrain-cache key to fold a leaked PDNLP_GELU_TANH into here:
         # serving would silently run tanh forwards over an erf-trained

@@ -13,4 +13,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r7_put_in_loop,
     r8_xla_attention,
     r9_blocking_ckpt,
+    r10_unspanned_serve_block,
 )

@@ -1,0 +1,136 @@
+"""R10 — serve dispatch paths that block on device results outside a span.
+
+The serve engine's contract (PR 4, extended by the replica router) is that
+every point where the serving path materializes device results lives inside
+a tracer span: the ``forward``/``compile``/``queue_wait``/``swap``
+vocabulary is what lets ``trace_tpu.py summarize`` build per-replica phase
+tables and the trace-diff gate catch latency regressions.  A dispatch path
+that calls ``jax.device_get``/``block_until_ready`` on a jitted forward's
+output OUTSIDE any span silently swallows device wait — the router looks
+fast while a replica's device stream is the bottleneck.
+
+Heuristic, per scope: a *dispatch-shaped* value (assigned from a call whose
+name contains ``jit`` or ``forward`` — the serve engine's ``_jit_forward``
+idiom) reaching a blocking fetch (``jax.device_get``,
+``jax.block_until_ready``, or an ``.block_until_ready()`` method) that is
+not lexically inside a ``with <tracer>.span(...)`` block.  ``Tracer.block``
+needs no exemption: it contains the barrier itself, so no raw fetch
+appears.  Only modules that import from ``pdnlp_tpu.serve`` (or live under
+``pdnlp_tpu/serve/``) are in scope — the bench/train layers have their own
+timing rules (R4).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from pdnlp_tpu.analysis.core import Finding, ModuleInfo, Rule, register
+
+_BLOCK_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_BLOCK_METHODS = {"block_until_ready"}
+
+
+def _dispatch_shaped(name: str) -> bool:
+    last = name.split(".")[-1].lower()
+    return "jit" in last or "forward" in last
+
+
+@register
+class UnspannedServeBlock(Rule):
+    rule_id = "R10"
+    name = "unspanned-serve-block"
+    hint = ("wrap the fetch in a tracer span — `with engine.tracer.span("
+            "'forward', ...): out = jax.device_get(logits)` — or use "
+            "`Tracer.block(out)` so the device wait lands in its own "
+            "device_block span (pdnlp_tpu.obs.trace); the serve/router "
+            "dispatch path must never block on device results invisibly")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not self._serve_module(mod):
+            return
+        for _, scope_node, body in mod.scopes():
+            yield from self._check_scope(mod, scope_node, body)
+
+    @staticmethod
+    def _serve_module(mod: ModuleInfo) -> bool:
+        if "pdnlp_tpu/serve/" in mod.path:
+            return True
+        return any(v.startswith("pdnlp_tpu.serve")
+                   for v in mod.aliases.values())
+
+    def _check_scope(self, mod: ModuleInfo, scope_node, body
+                     ) -> Iterator[Finding]:
+        own = [n for stmt in body for n in ast.walk(stmt)
+               if self._in_scope(mod, scope_node, n)]
+        dispatch_vars: Set[str] = set()
+        for node in own:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and self._is_dispatch_call(node.value):
+                dispatch_vars.add(node.targets[0].id)
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._is_block_call(mod, node):
+                continue
+            if not self._touches_dispatch(node, dispatch_vars):
+                continue
+            if self._inside_span(mod, node):
+                continue
+            yield self.finding(
+                mod, node,
+                "serve dispatch path blocks on device results outside any "
+                "tracer span — the device wait is invisible to the "
+                "per-replica phase tables and the trace-diff gate")
+
+    @staticmethod
+    def _is_dispatch_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            return _dispatch_shaped(fn.attr)
+        if isinstance(fn, ast.Name):
+            return _dispatch_shaped(fn.id)
+        return False
+
+    def _is_block_call(self, mod: ModuleInfo, call: ast.Call) -> bool:
+        if mod.resolves_to(call.func, _BLOCK_CALLS):
+            return True
+        return isinstance(call.func, ast.Attribute) \
+            and call.func.attr in _BLOCK_METHODS
+
+    def _touches_dispatch(self, call: ast.Call,
+                          dispatch_vars: Set[str]) -> bool:
+        """The fetch's operand IS (or mentions) a dispatch result — either
+        a tracked variable or an inline jit/forward call."""
+        targets = list(call.args)
+        if isinstance(call.func, ast.Attribute):  # x.block_until_ready()
+            targets.append(call.func.value)
+        for arg in targets:
+            for n in ast.walk(arg):
+                if isinstance(n, ast.Name) and n.id in dispatch_vars:
+                    return True
+                if self._is_dispatch_call(n):
+                    return True
+        return False
+
+    @staticmethod
+    def _inside_span(mod: ModuleInfo, node: ast.AST) -> bool:
+        p = mod.parents.get(node)
+        while p is not None:
+            if isinstance(p, ast.With):
+                for item in p.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Call) \
+                            and isinstance(ctx.func, ast.Attribute) \
+                            and ctx.func.attr == "span":
+                        return True
+            p = mod.parents.get(p)
+        return False
+
+    def _in_scope(self, mod: ModuleInfo, scope_node, node) -> bool:
+        fn = mod.enclosing_function(node)
+        if isinstance(scope_node, ast.Module):
+            return fn is None
+        return fn is scope_node or node is scope_node

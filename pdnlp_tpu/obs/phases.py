@@ -56,6 +56,14 @@ STEP_END_PHASE = "device_block"
 #: quantized serving)
 _ADOPTION_ATTRS = ("attn_impl", "dtype")
 
+#: the serve-side span vocabulary: ``queue_wait`` (batcher/router pre-batch
+#: wait, ``retry`` attr counts re-dispatched requests), ``forward`` /
+#: ``compile`` (engine execution, cache hit vs first-seen shape), ``swap``
+#: (a rolling checkpoint hot-swap).  Spans carrying a ``replica`` attr feed
+#: the PER-REPLICA phase tables — one sick replica must show up as itself
+#: in ``trace_tpu.py summarize``, not as a pool-average smear.
+SERVE_PHASES = ("queue_wait", "forward", "compile", "swap")
+
 
 def _bucket_key(bucket) -> tuple:
     """Numeric-aware sort for bucket labels: widths 16/32/64/128 order by
@@ -123,6 +131,10 @@ class StepBreakdown:
         # are tallied by value, so ``summarize``/the end-of-train table
         # show WHICH impl the hot path actually ran, not just how long
         self._impls: Dict[str, Dict[str, int]] = {}
+        # per-replica serve-phase durations (SERVE_PHASES spans with a
+        # ``replica`` attr) + retry counts from queue_wait records
+        self._serve: Dict[object, Dict[str, List[float]]] = {}
+        self._serve_retries: Dict[object, int] = {}
 
     # ------------------------------------------------------------- feeding
     def feed(self, record: Dict) -> None:
@@ -134,6 +146,16 @@ class StepBreakdown:
                 with self._lock:
                     by = self._impls.setdefault(key, {})
                     by[str(v)] = by.get(str(v), 0) + 1
+        if name in SERVE_PHASES and "replica" in attrs:
+            with self._lock:
+                per = self._serve.setdefault(attrs["replica"], {})
+                per.setdefault(name, []).append(
+                    float(record.get("dur", 0.0)))
+                retry = attrs.get("retry")
+                if retry:
+                    self._serve_retries[attrs["replica"]] = \
+                        self._serve_retries.get(attrs["replica"], 0) \
+                        + int(retry)
         if name not in PHASES:
             return
         full = float(record.get("dur", 0.0))
@@ -231,6 +253,25 @@ class StepBreakdown:
         if self._impls:
             out["impls"] = {k: dict(sorted(v.items(), key=lambda kv: -kv[1]))
                             for k, v in sorted(self._impls.items())}
+        if self._serve:
+            out["serve_by_replica"] = {
+                str(rep): {
+                    "retries": self._serve_retries.get(rep, 0),
+                    "phases": {
+                        phase: {
+                            "count": len(vals),
+                            "total_sec": round(sum(vals), 6),
+                            "mean_sec": round(sum(vals) / len(vals), 9),
+                            "p95_sec": round(
+                                _percentile(sorted(vals), 95), 9),
+                        }
+                        for phase, vals in sorted(
+                            per.items(), key=lambda kv: -sum(kv[1]))
+                    },
+                }
+                for rep, per in sorted(self._serve.items(),
+                                       key=lambda kv: _bucket_key(kv[0]))
+            }
         if self._per_bucket:
             out["by_bucket"] = {
                 str(bucket): {
@@ -279,6 +320,15 @@ def format_table(summary: Dict) -> str:
     for key, by in summary.get("impls", {}).items():
         lines.append(f"{key}: " + "  ".join(
             f"{val} x{n}" for val, n in by.items()))
+    # per-replica serve tables (router runs): one block per replica so a
+    # slow or retry-heavy replica reads as ITSELF, not a pool average
+    for rep, b in summary.get("serve_by_replica", {}).items():
+        lines.append(f"replica {rep}: {b['retries']} retried request(s)")
+        for phase, s in b["phases"].items():
+            lines.append(
+                f"  {phase:<12} {s['count']:>6d}x {s['total_sec']:>10.3f}s "
+                f"total {s['mean_sec'] * 1e3:>10.3f} ms mean "
+                f"{s['p95_sec'] * 1e3:>10.3f} ms p95")
     # per-bucket breakdown (length-aware runs): one line per bucket x
     # phase so a bucketed run's table shows where each width's time goes
     for bucket, b in summary.get("by_bucket", {}).items():

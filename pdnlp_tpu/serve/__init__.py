@@ -10,27 +10,43 @@ requests) — this package applies the same treatment to inference:
 - :mod:`pdnlp_tpu.serve.batcher` — bounded request queue with dynamic
   micro-batching (flush on size or ``max_wait_ms``), sequence-length
   bucketing, backpressure and per-request deadlines;
-- :mod:`pdnlp_tpu.serve.metrics` — latency/occupancy/cache observability,
-  JSON-snapshot compatible with the ``results/`` artifacts;
+- :mod:`pdnlp_tpu.serve.router` — N engine replicas behind tiered admission
+  (backpressure -> shed -> reject), least-loaded dispatch, heartbeat-based
+  health ejection with requeue/retry, warmup-gated reintegration, and
+  rolling checkpoint hot-swap (``serve_tpu.py --replicas N``);
+- :mod:`pdnlp_tpu.serve.metrics` — latency/occupancy/cache observability
+  (plus router/per-replica instruments), JSON-snapshot compatible with the
+  ``results/`` artifacts;
 - :mod:`pdnlp_tpu.serve.offline` — high-throughput whole-file scoring over
   the same bucketing (the deterministic surface tests and ``bench.py`` use).
 
 Entry point: ``serve_tpu.py`` at the repo root.
 """
 from pdnlp_tpu.serve.batcher import (  # noqa: F401
-    DEFAULT_BUCKETS, DeadlineExceeded, DynamicBatcher, QueueFullError,
-    pick_bucket,
+    DEFAULT_BUCKETS, AdmissionControl, DeadlineExceeded, DynamicBatcher,
+    LoadShedError, QueueFullError, pick_bucket,
 )
 from pdnlp_tpu.serve.engine import InferenceEngine  # noqa: F401
-from pdnlp_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from pdnlp_tpu.serve.metrics import (  # noqa: F401
+    ReplicaMetrics, RouterMetrics, ServeMetrics,
+)
 from pdnlp_tpu.serve.offline import score_texts  # noqa: F401
+from pdnlp_tpu.serve.router import (  # noqa: F401
+    ReplicaFailedError, ReplicaRouter,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "AdmissionControl",
     "DeadlineExceeded",
     "DynamicBatcher",
     "InferenceEngine",
+    "LoadShedError",
     "QueueFullError",
+    "ReplicaFailedError",
+    "ReplicaMetrics",
+    "ReplicaRouter",
+    "RouterMetrics",
     "ServeMetrics",
     "pick_bucket",
     "score_texts",

@@ -12,10 +12,17 @@ batches.  The batcher bridges the two:
   ``max_wait_ms`` (latency bound) — the classic size-or-timeout trigger;
 - **backpressure**: ``submit`` raises :class:`QueueFullError` once
   ``max_queue`` requests are pending — reject-with-error beats unbounded
-  memory growth and tells the caller to shed load;
+  memory growth and tells the caller to shed load.  The multi-replica
+  router replaces this single cliff with the tiered
+  :class:`AdmissionControl` ladder defined here (healthy -> bounded-wait
+  backpressure -> shed-lowest-deadline-slack -> hard reject);
 - **deadlines**: a request whose deadline passes while still queued is
   completed with :class:`DeadlineExceeded` and dropped from its batch, so
-  one stuck client degrades gracefully instead of stalling the queue.
+  one stuck client degrades gracefully instead of stalling the queue;
+  expiry is checked when the flush timer is computed AND again at dequeue
+  (a batch formed while the worker was busy must not carry corpses), and
+  ``result()`` without an explicit timeout bounds its wait by the
+  request's own remaining deadline budget.
 
 One worker thread owns the engine (JAX dispatch is not thread-safe-by-
 contract here, and a single dispatcher keeps the device busy without lock
@@ -43,6 +50,13 @@ class DeadlineExceeded(RuntimeError):
     """A request's deadline passed before its batch executed."""
 
 
+class LoadShedError(RuntimeError):
+    """A request was shed by tiered admission control (router overload tier:
+    lowest deadline slack goes first) — the caller should back off; unlike
+    :class:`QueueFullError` the queue is not hard-full, the request just
+    could not have made its deadline."""
+
+
 def usable_buckets(buckets: Sequence[int], max_seq_len: int) -> tuple:
     """The bucket list every serve path actually uses: capped at the
     model's padded length (encode truncates there, so a larger bucket could
@@ -63,9 +77,20 @@ def pick_bucket(n_tokens: int, buckets: Sequence[int]) -> int:
     return max(buckets)
 
 
+#: grace added to a deadline-derived ``result()`` timeout: a request can be
+#: mid-batch when its deadline passes, and the completion (or the expiry
+#: error) needs the batch's execution time to arrive
+RESULT_GRACE_SEC = 5.0
+
+#: completion is first-wins (a hedged/requeued request may be completed from
+#: two replicas; an ejected replica's hung worker may wake up later) — one
+#: tiny shared lock beats a per-request lock for objects this small
+_COMPLETE_LOCK = threading.Lock()
+
+
 class _Request:
-    __slots__ = ("ids", "bucket", "submitted", "deadline", "_event",
-                 "_logits", "_error")
+    __slots__ = ("ids", "bucket", "submitted", "deadline", "retries",
+                 "hedged", "_event", "_logits", "_error")
 
     def __init__(self, ids: List[int], bucket: int,
                  deadline: Optional[float]):
@@ -73,6 +98,8 @@ class _Request:
         self.bucket = bucket
         self.submitted = time.monotonic()
         self.deadline = deadline  # absolute monotonic seconds, or None
+        self.retries = 0          # router: requeues after replica failure
+        self.hedged = False       # router: a duplicate dispatch exists
         self._event = threading.Event()
         self._logits: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
@@ -80,7 +107,17 @@ class _Request:
     # --- the caller-facing future half ---
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block for the logits row; raises the request's error if it was
-        rejected by deadline or failed in the engine."""
+        rejected by deadline or failed in the engine.
+
+        ``timeout=None`` on a request WITH a deadline derives the wait from
+        the request's own remaining deadline budget (plus a grace window
+        for an in-flight batch) instead of blocking forever — a worker that
+        died mid-batch must surface as a bounded ``TimeoutError``, not a
+        hung caller.  A deadline-free request keeps the wait-forever
+        default."""
+        if timeout is None and self.deadline is not None:
+            timeout = max(0.0, self.deadline - time.monotonic()) \
+                + RESULT_GRACE_SEC
         if not self._event.wait(timeout):
             raise TimeoutError("request still pending")
         if self._error is not None:
@@ -90,12 +127,108 @@ class _Request:
     def done(self) -> bool:
         return self._event.is_set()
 
+    def slack(self, now: float) -> float:
+        """Remaining deadline budget in seconds (+inf when deadline-free) —
+        the shed tier's ordering key."""
+        return float("inf") if self.deadline is None else self.deadline - now
+
     # --- the worker-facing completion half ---
     def _complete(self, logits: Optional[np.ndarray],
-                  error: Optional[BaseException] = None) -> None:
-        self._logits = logits
-        self._error = error
-        self._event.set()
+                  error: Optional[BaseException] = None) -> bool:
+        """First completion wins; returns whether THIS call won (so metrics
+        count each request exactly once across hedges/requeues)."""
+        with _COMPLETE_LOCK:
+            if self._event.is_set():
+                return False
+            self._logits = logits
+            self._error = error
+            self._event.set()
+            return True
+
+
+class AdmissionControl:
+    """Tiered overload policy — the one cliff (:class:`QueueFullError` at
+    ``max_queue``) replaced with a ladder the router walks per submit:
+
+    ====================  ==================================================
+    tier (queue depth)    policy for the arriving request
+    ====================  ==================================================
+    healthy               ``< backpressure_at``: accept immediately
+    backpressure          ``[backpressure_at, shed_at)``: bounded wait (at
+                          most ``backpressure_wait_ms``, never past the
+                          request's own deadline slack) for depth to drop,
+                          then accept — converts a burst into latency
+                          instead of errors
+    shed                  ``[shed_at, max_queue)``: accept, but any request
+                          (the arrival or a queued one — LOWEST deadline
+                          slack first) whose remaining slack is under
+                          ``shed_slack_ms`` is shed with
+                          :class:`LoadShedError`: it could not have made
+                          its deadline anyway, and dropping it early frees
+                          capacity for requests that still can.  Deadline-
+                          free requests are never shed
+    reject                ``>= max_queue``: hard :class:`QueueFullError`
+                          (the PR-1 behavior, now the LAST resort)
+    ====================  ==================================================
+
+    Pure policy (no locks, injectable clock) so tier transitions are
+    unit-testable without threads; the queue mechanics stay in the caller.
+    The single-replica :class:`DynamicBatcher` keeps its legacy
+    reject-on-full contract (equivalent to ``backpressure_at = shed_at =
+    max_queue``); the multi-replica router wires the full ladder.
+    """
+
+    def __init__(self, max_queue: int, *,
+                 backpressure_at: Optional[int] = None,
+                 shed_at: Optional[int] = None,
+                 backpressure_wait_ms: float = 50.0,
+                 shed_slack_ms: float = 0.0,
+                 clock=time.monotonic):
+        self.max_queue = int(max_queue)
+        self.backpressure_at = int(backpressure_at if backpressure_at
+                                   is not None else self.max_queue // 2)
+        self.shed_at = int(shed_at if shed_at is not None
+                           else (self.max_queue * 3) // 4)
+        if not (self.backpressure_at <= self.shed_at <= self.max_queue):
+            raise ValueError(
+                f"tier thresholds must be ordered: backpressure_at "
+                f"{self.backpressure_at} <= shed_at {self.shed_at} <= "
+                f"max_queue {self.max_queue}")
+        self.backpressure_wait_ms = float(backpressure_wait_ms)
+        self.shed_slack_ms = float(shed_slack_ms)
+        self.clock = clock
+
+    def tier(self, pending: int) -> str:
+        """``healthy`` | ``backpressure`` | ``shed`` | ``reject``."""
+        if pending >= self.max_queue:
+            return "reject"
+        if pending >= self.shed_at:
+            return "shed"
+        if pending >= self.backpressure_at:
+            return "backpressure"
+        return "healthy"
+
+    def backpressure_wait_sec(self, req: "_Request") -> float:
+        """How long the submitter may be held in the backpressure tier:
+        the bounded wait, further capped by the request's own deadline
+        slack (waiting past its deadline would just shed it later)."""
+        wait = self.backpressure_wait_ms / 1e3
+        if req.deadline is not None:
+            wait = min(wait, max(0.0, req.slack(self.clock())))
+        return wait
+
+    def shed_victims(self, queued: Sequence["_Request"],
+                     arriving: Optional["_Request"] = None
+                     ) -> List["_Request"]:
+        """The requests the shed tier drops right now: lowest deadline
+        slack first, only while their slack is under ``shed_slack_ms``.
+        ``arriving`` participates like a queued request — the newcomer is
+        not privileged over requests already admitted."""
+        now = self.clock()
+        floor = self.shed_slack_ms / 1e3
+        cands = list(queued) + ([arriving] if arriving is not None else [])
+        doomed = [r for r in cands if r.slack(now) < floor]
+        return sorted(doomed, key=lambda r: r.slack(now))
 
 
 class DynamicBatcher:
@@ -274,6 +407,21 @@ class DynamicBatcher:
     def _execute(self, batch: List[_Request]) -> None:
         bucket = batch[0].bucket
         t0 = time.monotonic()
+        # dequeue-time expiry: the flush decision and this execution are
+        # separated by however long the worker spent on the PREVIOUS batch
+        # — a request whose deadline passed in that window must not ride
+        # the batch (its caller already gave up) nor hold a row
+        live = []
+        for r in batch:
+            if r.deadline is not None and t0 >= r.deadline:
+                self.metrics.deadline_expired_total.inc()
+                r._complete(None, DeadlineExceeded(
+                    "deadline passed while queued"))
+            else:
+                live.append(r)
+        batch = live
+        if not batch:
+            return
         for r in batch:
             self.metrics.queue_wait_ms.observe((t0 - r.submitted) * 1e3)
         # one queue_wait span per flushed batch, duration = its OLDEST

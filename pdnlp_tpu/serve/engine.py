@@ -115,6 +115,10 @@ class InferenceEngine:
         self.params = self._put(self._serving_template)
         self.checkpoint_path: Optional[str] = None
         self._seen_shapes: set = set()
+        # extra attrs stamped on every forward/compile span — the replica
+        # router labels each engine with its rank here, so per-replica
+        # phase tables (obs.phases) can attribute engine time per replica
+        self.span_attrs: Dict[str, object] = {}
 
         metrics_ref = self.metrics
         attn_impl = args.attention_impl
@@ -227,7 +231,8 @@ class InferenceEngine:
         # trace_tpu.py summarize and the trace-diff gate.
         with self.tracer.span(span_name, seq=int(seq), rows=int(rows),
                               dtype=self.dtype_label,
-                              attn_impl=self.routed_attn(int(seq))):
+                              attn_impl=self.routed_attn(int(seq)),
+                              **self.span_attrs):
             logits = self._jit_forward(self.params, fwd)
             out = np.asarray(jax.device_get(logits))
         return out

@@ -20,6 +20,12 @@ What each instrument answers:
   this must stay FLAT (the acceptance bar for the serve smoke);
 - ``requests_total`` / ``rejected_total`` / ``deadline_expired_total`` —
   admission accounting (rejects = backpressure, expiries = shed load).
+
+The multi-replica router adds :class:`RouterMetrics` (pool-level: per-tier
+admission counts, requeues/retries/hedges, ejections, swap + recovery
+accounting) and :class:`ReplicaMetrics` (replica-labelled queue depth,
+occupancy, requeue/retry/ejection counters) — composed by
+``ReplicaRouter.snapshot()`` into the ``bench.py --serve-load`` report.
 """
 from __future__ import annotations
 
@@ -64,8 +70,111 @@ class ServeMetrics:
 
     def save(self, path: str) -> None:
         """Atomic JSON dump (the ``results/`` artifact convention)."""
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.snapshot(), f, indent=2)
-        os.replace(tmp, path)
+        _save_json(self.snapshot(), path)
+
+
+def _save_json(obj: Dict, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2)
+    os.replace(tmp, path)
+
+
+class ReplicaMetrics:
+    """One replica's share of the router's observability — every instrument
+    is replica-labelled in the snapshot so a sick replica is visible as
+    ITSELF, not as a pool-average smear:
+
+    - ``queue_depth`` / ``inflight`` — where that replica's backlog stands;
+    - ``batch_occupancy`` — real rows / padded rows for batches IT executed;
+    - ``batches_total`` / ``requests_total`` — dispatch volume;
+    - ``requeued_out`` — requests moved OFF this replica at ejection (the
+      "ejected without dropping its queued requests" receipt);
+    - ``requeued_in`` — requests it absorbed from ejected peers;
+    - ``retries`` — failed-batch requests it re-dispatched after a replica
+      failure;
+    - ``ejections`` — times this slot's replica was ejected (dead/stalled).
+    """
+
+    def __init__(self) -> None:
+        self.queue_depth = Gauge()
+        self.inflight = Gauge()
+        self.batch_occupancy = Histogram()
+        self.batches_total = Counter()
+        self.requests_total = Counter()
+        self.requeued_out = Counter()
+        self.requeued_in = Counter()
+        self.retries = Counter()
+        self.ejections = Counter()
+
+    def snapshot(self) -> Dict:
+        return {
+            "queue_depth": self.queue_depth.value,
+            "inflight": self.inflight.value,
+            "batches_total": self.batches_total.value,
+            "requests_total": self.requests_total.value,
+            "requeued_out": self.requeued_out.value,
+            "requeued_in": self.requeued_in.value,
+            "retries": self.retries.value,
+            "ejections": self.ejections.value,
+            "batch_occupancy": self.batch_occupancy.snapshot(),
+        }
+
+
+class RouterMetrics:
+    """Pool-level router observability: admission tiers, failure handling,
+    and the recovery loop.  Per-tier shed accounting
+    (``admission`` block: backpressure waits / sheds / hard rejects) is
+    what the ``bench.py --serve-load`` report gates on — "tiered shedding
+    engaged" must be a recorded number, not an inference."""
+
+    def __init__(self) -> None:
+        self.requests_total = Counter()
+        self.completed_total = Counter()
+        self.failed_total = Counter()          # completed with a non-
+        #                                        deadline error (lost)
+        self.deadline_expired_total = Counter()
+        self.backpressure_waits_total = Counter()
+        self.shed_total = Counter()
+        self.rejected_total = Counter()
+        self.requeued_total = Counter()
+        self.retries_total = Counter()
+        self.hedges_total = Counter()
+        self.ejections_total = Counter()
+        self.reintegrations_total = Counter()
+        self.swaps_total = Counter()
+        self.swap_rollbacks_total = Counter()
+        self.queue_depth = Gauge()             # pool-wide pending
+        self.request_latency_ms = Histogram()
+        self.queue_wait_ms = Histogram()
+        self.backpressure_wait_ms = Histogram()
+        self.recovery_sec = Histogram()        # ejection -> healthy again
+
+    def snapshot(self) -> Dict:
+        return {
+            "requests_total": self.requests_total.value,
+            "completed_total": self.completed_total.value,
+            "failed_total": self.failed_total.value,
+            "deadline_expired_total": self.deadline_expired_total.value,
+            "admission": {
+                "backpressure_waits": self.backpressure_waits_total.value,
+                "shed": self.shed_total.value,
+                "rejected": self.rejected_total.value,
+            },
+            "requeued_total": self.requeued_total.value,
+            "retries_total": self.retries_total.value,
+            "hedges_total": self.hedges_total.value,
+            "ejections_total": self.ejections_total.value,
+            "reintegrations_total": self.reintegrations_total.value,
+            "swaps_total": self.swaps_total.value,
+            "swap_rollbacks_total": self.swap_rollbacks_total.value,
+            "queue_depth": self.queue_depth.value,
+            "request_latency_ms": self.request_latency_ms.snapshot(),
+            "queue_wait_ms": self.queue_wait_ms.snapshot(),
+            "backpressure_wait_ms": self.backpressure_wait_ms.snapshot(),
+            "recovery_sec": self.recovery_sec.snapshot(),
+        }
+
+    def save(self, path: str) -> None:
+        _save_json(self.snapshot(), path)

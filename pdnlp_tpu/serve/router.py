@@ -1,0 +1,885 @@
+"""Multi-replica serving router — the tier that survives overload and
+replica death.
+
+The PR-1 serve design is one engine behind one batcher: a dead device
+stream takes the whole service with it, and the only overload answer is a
+reject-on-full cliff.  This module fronts **N engine replicas** (one per
+device group / mesh slice, or N independent CPU engines in tests) with the
+robustness discipline PR 7 built for training:
+
+- **per-replica queues + least-loaded dispatch** — each replica keeps its
+  own per-bucket queues and ONE worker thread that owns its engine (the
+  single-dispatcher contract of :class:`~pdnlp_tpu.serve.batcher.
+  DynamicBatcher`, times N); an arriving request lands on the least-loaded
+  replica that can take it;
+- **tiered admission** (:class:`~pdnlp_tpu.serve.batcher.AdmissionControl`)
+  — healthy -> bounded-wait backpressure -> shed-lowest-deadline-slack ->
+  hard reject, replacing the single :class:`QueueFullError` cliff;
+- **health via the existing watchdog machinery** — every replica worker
+  writes a beat-payload :class:`~pdnlp_tpu.parallel.watchdog.Heartbeat`
+  (step = batches served) and a monitor thread reads them through a
+  :class:`~pdnlp_tpu.parallel.watchdog.GangMonitor` over per-replica
+  process adapters, so *crashed* (worker died) and *stalled* (worker wedged,
+  beats stopped) replicas are classified by the same verdict logic the
+  elastic trainer trusts;
+- **ejection without loss** — an ejected replica's queued requests are
+  requeued onto survivors within their remaining deadline budget; its
+  in-flight batch is re-dispatched with a per-request retry budget
+  (``max_retries``); completion is first-wins, so a wedged worker waking up
+  later can never double-complete;
+- **warmup-gated reintegration** — a relaunched replica serves nothing
+  until its worker has re-run the bucket warmup, so reintegration can never
+  introduce post-warmup retraces (each replica's retrace counter is
+  baselined at the end of ITS warmup);
+- **rolling checkpoint hot-swap** — :meth:`swap_checkpoint` drains and
+  swaps one replica at a time; a corrupt artifact
+  (:class:`~pdnlp_tpu.train.checkpoint.CorruptCheckpointError`, or a
+  template mismatch) rolls back that replica (the engine's params are
+  untouched on a failed load) and aborts the rollout instead of poisoning
+  the rest of the pool;
+- **optional tail hedging** — a request stuck in a queue past ``hedge_ms``
+  with deadline budget left is duplicated onto a less-loaded replica;
+  first completion wins.
+
+Single-replica serving is untouched: :class:`DynamicBatcher` remains the
+default path (``serve_tpu.py`` only builds a router under ``--replicas N``
+with N > 1).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from pdnlp_tpu.parallel.watchdog import GangMonitor, Heartbeat
+from pdnlp_tpu.serve.batcher import (
+    DEFAULT_BUCKETS, AdmissionControl, DeadlineExceeded, LoadShedError,
+    QueueFullError, _Request, pick_bucket, usable_buckets,
+)
+from pdnlp_tpu.serve.metrics import ReplicaMetrics, RouterMetrics
+from pdnlp_tpu.train.checkpoint import CorruptCheckpointError
+
+
+class ReplicaFailedError(RuntimeError):
+    """A request's replica died and its retry budget is exhausted (or no
+    survivor was available to take it)."""
+
+
+class _InjectedFault(RuntimeError):
+    """Raised inside a replica worker by the chaos hooks — stands in for
+    the process death / wedge a SIGKILL'd or hung replica would show."""
+
+
+class _Replica:
+    """One replica incarnation: an engine, its queues, and worker state.
+
+    States: ``warming`` (worker is pre-tracing every bucket; not
+    dispatchable) -> ``healthy`` -> ``draining`` (rolling swap: finish
+    in-flight, accept queue but execute nothing) -> back to ``healthy``;
+    ``ejected`` is terminal for THIS incarnation (a relaunch builds a new
+    one in the same slot)."""
+
+    def __init__(self, index: int, engine, buckets: Sequence[int],
+                 flush_rows: int):
+        self.index = index
+        self.engine = engine
+        self.state = "warming"
+        # the flush threshold is the PADDED row count (DynamicBatcher's
+        # lesson): executed batches pad to the replica's mesh data-axis
+        # multiple anyway, so flushing at a smaller size would cap this
+        # replica's occupancy below 1.0 forever
+        self.flush_rows = int(flush_rows)
+        self.queues: Dict[int, List[_Request]] = {b: [] for b in buckets}
+        self.inflight: List[_Request] = []
+        self.exit_code: Optional[int] = None  # None while the worker lives
+        self.batches = 0
+        self.retrace_warm: Optional[int] = None  # retraces at end of warmup
+        self.fault: Optional[str] = None  # chaos hook: "crash" | "hang"
+        self.worker: Optional[threading.Thread] = None
+        self.hb: Optional[Heartbeat] = None
+
+    def queued(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    def load(self) -> int:
+        return self.queued() + len(self.inflight)
+
+    @property
+    def retraces_post_warmup(self) -> int:
+        if self.retrace_warm is None:
+            return 0
+        return self.engine.metrics.retraces.value - self.retrace_warm
+
+
+class _Slot:
+    """Stable per-rank holder: the GangMonitor adapter and the replica-
+    labelled metrics survive relaunches, so rank i's history is one series
+    even as incarnations come and go."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.replica: Optional[_Replica] = None
+        self.metrics = ReplicaMetrics()
+        self.ejected_at: Optional[float] = None
+
+
+class _ReplicaProc:
+    """Quacks like a subprocess for :class:`GangMonitor`: ``poll()`` is
+    None while the slot's current worker lives, its synthetic exit code
+    after a crash, and 0 once the router has processed the ejection (so a
+    handled crash stops short-circuiting the monitor's stall checks for
+    the OTHER ranks)."""
+
+    def __init__(self, slot: _Slot):
+        self._slot = slot
+
+    def poll(self) -> Optional[int]:
+        rep = self._slot.replica
+        if rep is None or rep.state == "ejected":
+            return 0
+        return rep.exit_code
+
+    def terminate(self) -> None:  # pragma: no cover - monitor API surface
+        pass
+
+    def kill(self) -> None:  # pragma: no cover - monitor API surface
+        pass
+
+
+class ReplicaRouter:
+    """N engine replicas behind tiered admission + health-ejecting dispatch
+    (module docstring has the full story).
+
+    ``engines`` seeds the pool; ``engine_factory(index)`` (optional) lets
+    :meth:`relaunch` build replacement engines after an ejection.  All
+    engines must share a tokenizer/bucket view (they are replicas, not a
+    heterogeneous fleet).
+
+    ``clock`` (deadlines/latency, default ``time.monotonic``) and
+    ``health_clock`` (heartbeat domain, default ``time.time``) are
+    injectable so tier transitions and slack ordering are testable without
+    sleeping.
+    """
+
+    def __init__(
+        self,
+        engines: Sequence,
+        *,
+        engine_factory: Optional[Callable[[int], object]] = None,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_batch_size: int = 8,
+        max_wait_ms: float = 5.0,
+        max_queue: int = 256,
+        default_deadline_ms: Optional[float] = None,
+        backpressure_at: Optional[int] = None,
+        shed_at: Optional[int] = None,
+        backpressure_wait_ms: float = 50.0,
+        shed_slack_ms: Optional[float] = None,
+        max_retries: int = 1,
+        hedge_ms: Optional[float] = None,
+        stall_timeout: float = 10.0,
+        poll_interval: float = 0.1,
+        hb_dir: Optional[str] = None,
+        checkpoint_path: Optional[str] = None,
+        metrics: Optional[RouterMetrics] = None,
+        tracer=None,
+        clock: Callable[[], float] = time.monotonic,
+        health_clock: Callable[[], float] = time.time,
+    ):
+        if not engines:
+            raise ValueError("ReplicaRouter needs at least one engine")
+        self.engine_factory = engine_factory
+        self._tokenizer = engines[0].tokenizer
+        self.buckets = usable_buckets(buckets, engines[0].args.max_seq_len)
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_ms = float(max_wait_ms)
+        self.default_deadline_ms = default_deadline_ms
+        # a request with less remaining slack than two flush waits cannot
+        # make its deadline once the pool is in the shed band — that is the
+        # default "doomed" floor the shed tier drops first
+        self.admission = AdmissionControl(
+            max_queue, backpressure_at=backpressure_at, shed_at=shed_at,
+            backpressure_wait_ms=backpressure_wait_ms,
+            shed_slack_ms=(2 * max_wait_ms if shed_slack_ms is None
+                           else shed_slack_ms),
+            clock=clock)
+        self.max_retries = int(max_retries)
+        self.hedge_ms = hedge_ms
+        self.stall_timeout = float(stall_timeout)
+        self.poll_interval = float(poll_interval)
+        self.metrics = metrics or RouterMetrics()
+        self.tracer = tracer if tracer is not None else engines[0].tracer
+        self.clock = clock
+        self.health_clock = health_clock
+        self.hb_dir = hb_dir or tempfile.mkdtemp(prefix="pdnlp-serve-hb-")
+        self._beat_interval = min(1.0, self.stall_timeout / 5.0)
+
+        self._slots = [_Slot(i) for i in range(len(engines))]
+        for slot, engine in zip(self._slots, engines):
+            slot.replica = self._make_replica(slot.index, engine)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending = 0          # accepted, not yet completed
+        self._stop = False
+        self._started = False
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._mon: Optional[GangMonitor] = None
+        # the checkpoint every incarnation must serve: factory-built
+        # relaunch engines load it during their warmup; a successful
+        # rolling swap advances it
+        self._checkpoint_path = checkpoint_path
+
+    # ------------------------------------------------------------ lifecycle
+    def _make_replica(self, index: int, engine) -> _Replica:
+        rep = _Replica(index, engine, self.buckets,
+                       engine.pad_rows(self.max_batch_size))
+        rep.hb = Heartbeat(self.hb_dir, index, interval=self._beat_interval,
+                           clock=self.health_clock)
+        # forward/compile spans carry the replica rank so the per-replica
+        # phase tables (obs.phases) can attribute engine time per replica
+        engine.span_attrs = {"replica": index}
+        return rep
+
+    def start(self) -> "ReplicaRouter":
+        if self._started:
+            return self
+        self._started = True
+        self._stop = False
+        for slot in self._slots:
+            self._start_worker(slot.replica)
+        self._mon = GangMonitor(
+            [_ReplicaProc(s) for s in self._slots], self.hb_dir,
+            len(self._slots), stall_timeout=self.stall_timeout,
+            clock=self.health_clock)
+        self._monitor_thread = threading.Thread(
+            target=self._monitor, daemon=True, name="pdnlp-serve-monitor")
+        self._monitor_thread.start()
+        return self
+
+    def _start_worker(self, rep: _Replica) -> None:
+        rep.worker = threading.Thread(
+            target=self._worker, args=(rep,), daemon=True,
+            name=f"pdnlp-serve-replica{rep.index}")
+        rep.worker.start()
+
+    def wait_ready(self, timeout: float = 120.0) -> bool:
+        """Block until every (non-ejected) replica finished its warmup."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while time.monotonic() < deadline:
+                reps = [s.replica for s in self._slots if s.replica]
+                if reps and all(r.state in ("healthy", "draining", "ejected")
+                                for r in reps) \
+                        and any(r.state != "ejected" for r in reps):
+                    return True
+                self._cond.wait(timeout=0.05)
+        return False
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Shut the pool down; ``drain=True`` serves what is queued first
+        (bounded by ``timeout`` and by replica liveness — a dead pool
+        cannot drain, it fails what is left loudly instead)."""
+        if drain:
+            deadline = time.monotonic() + timeout
+            with self._lock:
+                while self._pending and time.monotonic() < deadline:
+                    if not any(s.replica and s.replica.state in
+                               ("healthy", "warming", "draining")
+                               and s.replica.exit_code is None
+                               for s in self._slots):
+                        break  # nobody left to serve the backlog
+                    self._cond.wait(timeout=0.05)
+        with self._lock:
+            self._stop = True
+            self._cond.notify_all()
+            leftovers = []
+            for slot in self._slots:
+                rep = slot.replica
+                if rep is None:
+                    continue
+                for q in rep.queues.values():
+                    leftovers += [r for r in q if not r.done()]
+                    q.clear()
+                leftovers += [r for r in rep.inflight if not r.done()]
+        for t in [s.replica.worker for s in self._slots
+                  if s.replica and s.replica.worker] \
+                + ([self._monitor_thread] if self._monitor_thread else []):
+            t.join(timeout=5)
+        self._started = False
+        self._monitor_thread = None
+        for r in leftovers:
+            self._finish(r, error=RuntimeError("router stopped"))
+
+    def __enter__(self) -> "ReplicaRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- metrics
+    def _finish(self, r: _Request, logits=None, error=None,
+                latency: bool = False) -> bool:
+        """Complete ``r`` exactly once and keep the pool accounting true
+        (first completion decrements pending; hedged losers are no-ops)."""
+        with self._lock:
+            return self._finish_locked(r, logits, error, latency=latency)
+
+    def _finish_locked(self, r: _Request, logits=None, error=None,
+                       latency: bool = False) -> bool:
+        """:meth:`_finish`'s core, for callers already holding the router
+        lock — ONE copy of the completion/error taxonomy so the counters
+        and the latency histogram the p99 gate reads cannot drift."""
+        won = r._complete(logits, error)
+        if won:
+            self._pending -= 1
+            self.metrics.queue_depth.set(self._pending)
+            if error is None:
+                self.metrics.completed_total.inc()
+                if latency:
+                    self.metrics.request_latency_ms.observe(
+                        (self.clock() - r.submitted) * 1e3)
+            elif isinstance(error, DeadlineExceeded):
+                self.metrics.deadline_expired_total.inc()
+            elif isinstance(error, LoadShedError):
+                self.metrics.shed_total.inc()
+            else:
+                self.metrics.failed_total.inc()
+            self._cond.notify_all()
+        return won
+
+    # -------------------------------------------------------------- submit
+    def submit(self, text: str,
+               deadline_ms: Optional[float] = None) -> _Request:
+        """Enqueue one text (same truncation contract as the batcher)."""
+        ids = self._tokenizer.encode_ids(text, self.buckets[-1])
+        return self.submit_ids(ids, deadline_ms=deadline_ms)
+
+    def submit_ids(self, ids: List[int],
+                   deadline_ms: Optional[float] = None) -> _Request:
+        """Tiered admission + least-loaded dispatch; returns the future.
+
+        Raises :class:`QueueFullError` (hard-full, or no replica able to
+        take the request) or :class:`LoadShedError` (the shed tier dropped
+        the arrival itself: its deadline slack was the pool's lowest and
+        under the viability floor)."""
+        if len(ids) > self.buckets[-1]:
+            ids = list(ids)[: self.buckets[-1]]
+        deadline_ms = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        now = self.clock()
+        deadline = (now + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        req = _Request(ids, pick_bucket(len(ids), self.buckets), deadline)
+        req.submitted = now  # _Request stamps time.monotonic; re-stamp in
+        req.deadline = deadline  # the router's (injectable) clock domain
+        with self._lock:
+            if self._stop or not self._started:
+                raise RuntimeError("router is not running (call start())")
+            self._admit(req)
+            slot = self._pick_slot(exclude=None)
+            if slot is None:
+                self.metrics.rejected_total.inc()
+                raise QueueFullError("no replica available (all ejected?)")
+            self._enqueue(slot, req)
+            self.metrics.requests_total.inc()
+            self._pending += 1
+            self.metrics.queue_depth.set(self._pending)
+            self._cond.notify_all()
+        return req
+
+    def _admit(self, req: _Request) -> None:
+        """Walk the admission ladder under the lock; raises to refuse."""
+        adm = self.admission
+        waited = False
+        while True:
+            tier = adm.tier(self._pending)
+            if tier == "healthy":
+                return
+            if tier == "backpressure":
+                if waited:
+                    return  # bounded wait paid: accept at elevated depth
+                waited = True
+                self.metrics.backpressure_waits_total.inc()
+                wait = adm.backpressure_wait_sec(req)
+                t0 = time.monotonic()
+                self._cond.wait(timeout=wait)
+                self.metrics.backpressure_wait_ms.observe(
+                    (time.monotonic() - t0) * 1e3)
+                continue  # re-evaluate: depth may have dropped OR grown
+            if tier == "shed":
+                self._shed_pass(arriving=req)
+                if req.done():  # the arrival itself was the doomed one
+                    raise LoadShedError(
+                        "shed: lowest deadline slack in the pool and under "
+                        f"the {adm.shed_slack_ms:.0f}ms viability floor")
+                return  # accepted at shed depth (its slack is viable)
+            # tier == "reject"
+            self.metrics.rejected_total.inc()
+            raise QueueFullError(
+                f"queue full ({self._pending}/{adm.max_queue})")
+
+    def _shed_pass(self, arriving: Optional[_Request] = None) -> None:
+        """Shed-tier sweep (caller holds the lock): drop the doomed,
+        lowest-slack first, across every replica queue."""
+        queued = [r for s in self._slots if s.replica
+                  for q in s.replica.queues.values() for r in q
+                  if not r.done()]
+        victims = self.admission.shed_victims(queued, arriving=arriving)
+        if not victims:
+            return
+        victimset = set(map(id, victims))
+        for s in self._slots:
+            if s.replica is None:
+                continue
+            for q in s.replica.queues.values():
+                q[:] = [r for r in q if id(r) not in victimset]
+        for r in victims:
+            if r is arriving:
+                r._complete(None, LoadShedError("shed on arrival"))
+                self.metrics.shed_total.inc()
+            else:
+                self._finish_locked(r, error=LoadShedError(
+                    "shed while queued: overload tier, lowest deadline "
+                    "slack first"))
+
+    def _pick_slot(self, exclude: Optional[int]) -> Optional[_Slot]:
+        """Least-loaded dispatchable slot (healthy first; a warming or
+        draining replica is a valid queue target — it just executes later
+        — but never preferred over a healthy one)."""
+        def candidates(states):
+            return [s for s in self._slots
+                    if s.index != exclude and s.replica is not None
+                    and s.replica.state in states
+                    and s.replica.exit_code is None]
+
+        for states in (("healthy",), ("warming", "draining")):
+            cands = candidates(states)
+            if cands:
+                return min(cands, key=lambda s: s.replica.load())
+        return None
+
+    def _enqueue(self, slot: _Slot, req: _Request) -> None:
+        slot.replica.queues[req.bucket].append(req)
+        slot.metrics.requests_total.inc()
+        slot.metrics.queue_depth.set(slot.replica.queued())
+
+    # -------------------------------------------------------------- worker
+    def _worker(self, rep: _Replica) -> None:
+        try:
+            self._warm(rep)
+            while True:
+                if rep.fault == "crash":  # chaos hook fires even when idle
+                    raise _InjectedFault(
+                        f"replica {rep.index} killed (injected)")
+                if rep.fault != "hang":  # a wedged process beats no more
+                    rep.hb.beat(step=rep.batches)
+                with self._lock:
+                    if self._stop or rep.state == "ejected":
+                        return
+                    batch = None
+                    if rep.state == "healthy":
+                        batch = self._take_flushable(rep)
+                    if batch is None:
+                        # a non-healthy replica (draining/warming) must
+                        # NOT derive its wakeup from overdue queue ticks —
+                        # _next_wakeup would return 0 and the worker would
+                        # busy-spin on the router lock for the whole drain
+                        timeout = (self._next_wakeup(rep)
+                                   if rep.state == "healthy" else None)
+                        self._cond.wait(timeout=min(
+                            self._beat_interval,
+                            timeout if timeout is not None else 3600.0))
+                        continue
+                    rep.inflight = batch
+                    slot = self._slots[rep.index]
+                    slot.metrics.queue_depth.set(rep.queued())
+                    slot.metrics.inflight.set(len(batch))
+                self._execute(rep, batch)
+                with self._lock:
+                    rep.inflight = []
+                    rep.batches += 1
+                    slot = self._slots[rep.index]
+                    slot.metrics.queue_depth.set(rep.queued())
+                    slot.metrics.inflight.set(0)
+                    self._cond.notify_all()
+        except BaseException:  # noqa: BLE001 — a dying worker must leave a
+            # verdict behind: the monitor classifies the crash, ejects the
+            # replica, and requeues its queued + in-flight requests onto
+            # survivors.  Deliberately NO cleanup here — a SIGKILL'd
+            # process would not have run any either, and one recovery path
+            # (ejection) is easier to trust than two.
+            rep.exit_code = 1
+
+    def _warm(self, rep: _Replica) -> None:
+        """Warmup-gated (re)integration: pre-trace every bucket shape, then
+        baseline the retrace counter — only after that may dispatch see
+        this replica, so a relaunch can never introduce a post-warmup
+        retrace."""
+        rep.hb.beat(force=True)  # the monitor's grace clock starts now
+        if self._checkpoint_path and \
+                getattr(rep.engine, "checkpoint_path", None) \
+                != self._checkpoint_path:
+            rep.engine.load_checkpoint(self._checkpoint_path)
+        for seq in self.buckets:
+            rep.engine.infer_ids(
+                [[self._tokenizer.cls_id, self._tokenizer.sep_id]], seq,
+                rows=rep.flush_rows)
+            rep.hb.beat(force=True)  # a slow compile must not read as a stall
+        rep.retrace_warm = rep.engine.metrics.retraces.value
+        with self._lock:
+            slot = self._slots[rep.index]
+            # recovery/reintegration are recorded ONLY on a real warming ->
+            # healthy transition: an incarnation ejected mid-warmup never
+            # serves, and claiming its recovery would let the serve-load
+            # gates pass on a pool that is actually a replica short
+            if rep.state == "warming":
+                rep.state = "healthy"
+                if slot.ejected_at is not None:
+                    self.metrics.recovery_sec.observe(
+                        self.clock() - slot.ejected_at)
+                    slot.ejected_at = None
+                    self.metrics.reintegrations_total.inc()
+            self._cond.notify_all()
+
+    def _take_flushable(self, rep: _Replica) -> Optional[List[_Request]]:
+        """Under the lock: expire/skip dead entries, then pop a full bucket
+        or the most-overdue aged one (the batcher's flush policy, per
+        replica)."""
+        now = self.clock()
+        for q in rep.queues.values():
+            keep = []
+            for r in q:
+                if r.done():  # hedge copy whose original already finished
+                    continue
+                if r.deadline is not None and now >= r.deadline:
+                    self._finish_locked(r, error=DeadlineExceeded(
+                        "deadline passed while queued"))
+                else:
+                    keep.append(r)
+            q[:] = keep
+        for b, q in rep.queues.items():
+            if len(q) >= rep.flush_rows:
+                return self._pop(rep, b)
+        aged = [(q[0].submitted, b) for b, q in rep.queues.items() if q]
+        if aged:
+            oldest, b = min(aged)
+            if (now - oldest) * 1e3 >= self.max_wait_ms:
+                return self._pop(rep, b)
+        return None
+
+    def _pop(self, rep: _Replica, bucket: int) -> List[_Request]:
+        q = rep.queues[bucket]
+        batch, q[:] = q[: rep.flush_rows], q[rep.flush_rows:]
+        return batch
+
+    def _next_wakeup(self, rep: _Replica) -> Optional[float]:
+        now = self.clock()
+        ticks = []
+        for q in rep.queues.values():
+            for r in q:
+                ticks.append(r.submitted + self.max_wait_ms / 1e3)
+                if r.deadline is not None:
+                    ticks.append(r.deadline)
+        if not ticks:
+            return None
+        return max(0.0, min(ticks) - now)
+
+    def _execute(self, rep: _Replica, batch: List[_Request]) -> None:
+        """Run one batch on ``rep``'s engine (outside the lock).  Chaos
+        hooks fire here; any engine exception condemns the replica (its
+        worker dies with the verdict, the monitor handles recovery)."""
+        if rep.fault == "crash":
+            raise _InjectedFault(f"replica {rep.index} killed (injected)")
+        while rep.fault == "hang":
+            # wedged, beats stopped: hold the in-flight batch until the
+            # monitor ejects us — the stalled-replica failure shape
+            if rep.state == "ejected" or self._stop:
+                raise _InjectedFault(f"replica {rep.index} wedged (injected)")
+            time.sleep(0.02)
+        bucket = batch[0].bucket
+        t0 = self.clock()
+        retried = sum(1 for r in batch if r.retries)
+        for r in batch:
+            self.metrics.queue_wait_ms.observe((t0 - r.submitted) * 1e3)
+        tr = self.tracer
+        if tr.enabled:
+            now = tr.now()
+            oldest = max(t0 - r.submitted for r in batch)
+            tr.record("queue_wait", now - oldest, now, replica=rep.index,
+                      bucket=bucket, rows=len(batch), retry=retried)
+        rows = rep.flush_rows
+        logits = rep.engine.infer_ids([r.ids for r in batch], bucket,
+                                      rows=rows)
+        slot = self._slots[rep.index]
+        slot.metrics.batches_total.inc()
+        slot.metrics.batch_occupancy.observe(len(batch) / rows)
+        for i, r in enumerate(batch):
+            self._finish(r, logits=logits[i], latency=True)
+
+    # ------------------------------------------------------------- monitor
+    def _monitor(self) -> None:
+        """Health loop: GangMonitor verdicts -> ejection; plus the deadline
+        sweep and the hedging scan each tick."""
+        while True:
+            time.sleep(self.poll_interval)
+            with self._lock:
+                if self._stop:
+                    return
+                self._sweep_expired()
+                if self.hedge_ms is not None:
+                    self._hedge_scan()
+            verdict = self._mon.poll()
+            if not verdict or verdict.get("kind") not in ("crashed",
+                                                          "stalled"):
+                continue
+            for i in verdict.get("dead_ranks", []):
+                slot = self._slots[i]
+                rep = slot.replica
+                if rep is None or rep.state == "ejected":
+                    continue
+                if verdict["kind"] == "stalled" and rep.state == "warming":
+                    # warmup compiles can outlast stall_timeout (the same
+                    # reason Heartbeat skips its construction beat and the
+                    # GangMonitor grants a pre-first-beat grace window):
+                    # beats land between buckets, but ONE bucket's XLA
+                    # compile is allowed to run long.  A warming replica
+                    # is not dispatch-preferred, so leniency costs
+                    # nothing; a crashed warmup still ejects above.
+                    continue
+                self._eject(i, verdict["kind"])
+
+    def _sweep_expired(self) -> None:
+        now = self.clock()
+        for s in self._slots:
+            rep = s.replica
+            if rep is None:
+                continue
+            for q in rep.queues.values():
+                keep = []
+                for r in q:
+                    if r.done():
+                        continue
+                    if r.deadline is not None and now >= r.deadline:
+                        self._finish_locked(r, error=DeadlineExceeded(
+                            "deadline passed while queued"))
+                    else:
+                        keep.append(r)
+                q[:] = keep
+
+    def _hedge_scan(self) -> None:
+        """Tail hedging, bounded by the deadline budget: a request queued
+        past ``hedge_ms`` that still has slack gets ONE duplicate on a
+        strictly less-loaded healthy replica; first completion wins."""
+        now = self.clock()
+        for s in self._slots:
+            rep = s.replica
+            if rep is None or rep.state == "ejected":
+                continue
+            for q in rep.queues.values():
+                for r in q:
+                    if (r.hedged or r.done()
+                            or (now - r.submitted) * 1e3 < self.hedge_ms
+                            or r.slack(now) <= 0):
+                        continue
+                    target = self._pick_slot(exclude=rep.index)
+                    if target is None or \
+                            target.replica.load() >= rep.load():
+                        continue
+                    r.hedged = True
+                    target.replica.queues[r.bucket].append(r)
+                    target.metrics.queue_depth.set(target.replica.queued())
+                    self.metrics.hedges_total.inc()
+                    self._cond.notify_all()
+
+    def _eject(self, index: int, reason: str) -> None:
+        """Remove a dead/stalled replica from dispatch and move every one
+        of its requests (queued AND in-flight) onto survivors within their
+        remaining deadline budget."""
+        with self._lock:
+            slot = self._slots[index]
+            rep = slot.replica
+            rep.state = "ejected"
+            slot.ejected_at = self.clock()
+            self.metrics.ejections_total.inc()
+            slot.metrics.ejections.inc()
+            queued = [r for q in rep.queues.values() for r in q]
+            inflight = list(rep.inflight)
+            for q in rep.queues.values():
+                q.clear()
+            rep.inflight = []
+            slot.metrics.queue_depth.set(0)
+            slot.metrics.inflight.set(0)
+            now = self.clock()
+            for r, was_inflight in [(r, False) for r in queued] \
+                    + [(r, True) for r in inflight]:
+                if r.done():
+                    continue
+                # a hedged request whose copy already lives on a survivor
+                # needs no requeue — appending it again would put the SAME
+                # request twice in one queue and waste a padded row
+                if r.hedged and any(
+                        s.replica is not None
+                        and s.replica.state != "ejected"
+                        and any(r in q
+                                for q in s.replica.queues.values())
+                        for s in self._slots if s.index != index):
+                    continue
+                if r.deadline is not None and now >= r.deadline:
+                    self._finish_locked(r, error=DeadlineExceeded(
+                        f"deadline passed during replica {index} ejection"))
+                    continue
+                if was_inflight and r.retries >= self.max_retries:
+                    self._finish_locked(r, error=ReplicaFailedError(
+                        f"replica {index} {reason}; retry budget "
+                        f"({self.max_retries}) exhausted"))
+                    continue
+                target = self._pick_slot(exclude=index)
+                if target is None:
+                    self._finish_locked(r, error=ReplicaFailedError(
+                        f"replica {index} {reason}; no survivor to take "
+                        "the request"))
+                    continue
+                if was_inflight:
+                    r.retries += 1
+                    self.metrics.retries_total.inc()
+                    target.metrics.retries.inc()
+                else:
+                    self.metrics.requeued_total.inc()
+                slot.metrics.requeued_out.inc()
+                target.metrics.requeued_in.inc()
+                target.replica.queues[r.bucket].append(r)
+                target.metrics.queue_depth.set(target.replica.queued())
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------ recovery
+    def kill_replica(self, index: int, kind: str = "crash") -> None:
+        """Chaos hook (tests, ``bench.py --serve-load``): make replica
+        ``index`` die like a SIGKILL'd process (``crash``: worker dies,
+        beats stop) or wedge like a stuck device stream (``hang``: worker
+        holds its batch, beats stop)."""
+        if kind not in ("crash", "hang"):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        with self._lock:
+            self._slots[index].replica.fault = kind
+            self._cond.notify_all()
+
+    def relaunch(self, index: int, engine=None) -> None:
+        """Replace an ejected replica with a fresh incarnation.  The new
+        engine loads the pool's current checkpoint and re-runs the bucket
+        warmup on its worker BEFORE turning healthy (warmup-gated
+        reintegration); recovery time (ejection -> healthy) lands in
+        ``metrics.recovery_sec``."""
+        if engine is None:
+            if self.engine_factory is None:
+                raise ValueError("relaunch needs an engine or a factory")
+            engine = self.engine_factory(index)
+        with self._lock:
+            old = self._slots[index].replica
+            if old is not None and old.state not in ("ejected",):
+                raise RuntimeError(
+                    f"replica {index} is {old.state}, not ejected")
+            rep = self._make_replica(index, engine)
+            # the dead incarnation's LAST beat is >= stall_timeout old by
+            # construction; a fresh beat must land BEFORE the slot flips
+            # live, or the monitor's very next poll reads the stale age
+            # against a now-alive adapter and falsely ejects the newcomer
+            rep.hb.beat(force=True)
+            self._slots[index].replica = rep
+        self._start_worker(rep)
+
+    def swap_checkpoint(self, path: str) -> Dict:
+        """Rolling hot-swap: drain + swap one replica at a time so the pool
+        keeps serving throughout.  A corrupt artifact
+        (:class:`CorruptCheckpointError`) or template mismatch ROLLS BACK
+        that replica (a failed load leaves the engine's params untouched)
+        and aborts the rollout — a bad file must cost one replica's swap
+        attempt, never the pool.  Returns a report dict."""
+        report: Dict = {"path": path, "swapped": [], "rolled_back": [],
+                        "skipped": []}
+        for slot in self._slots:
+            with self._lock:
+                rep = slot.replica
+                if rep is None or rep.state != "healthy":
+                    report["skipped"].append(slot.index)
+                    continue
+                rep.state = "draining"
+                self._cond.notify_all()
+            # wait out the in-flight batch (new dispatch is paused; its
+            # queue keeps accepting and survivors keep serving)
+            with self._lock:
+                while rep.inflight and rep.exit_code is None \
+                        and not self._stop:
+                    self._cond.wait(timeout=0.02)
+                # the replica may have died or been ejected DURING the
+                # drain wait (or the router may be stopping) — swapping a
+                # corpse must not count as a successful rollout step
+                if self._stop or rep.exit_code is not None \
+                        or rep.state != "draining":
+                    if rep.state == "draining" and rep.exit_code is None:
+                        rep.state = "healthy"  # un-pause a stop-skipped one
+                    report["skipped"].append(slot.index)
+                    continue
+            try:
+                with self.tracer.span("swap", replica=slot.index,
+                                      path=os.path.basename(path)):
+                    rep.engine.load_checkpoint(path)
+                self.metrics.swaps_total.inc()
+                report["swapped"].append(slot.index)
+            except (CorruptCheckpointError, ValueError) as e:
+                self.metrics.swap_rollbacks_total.inc()
+                report["rolled_back"].append(slot.index)
+                report["error"] = f"{type(e).__name__}: {e}"
+                with self._lock:
+                    if rep.state == "draining":
+                        rep.state = "healthy"
+                    self._cond.notify_all()
+                break
+            with self._lock:
+                if rep.state == "draining":
+                    rep.state = "healthy"
+                self._cond.notify_all()
+        if report["swapped"] and not report["rolled_back"]:
+            self._checkpoint_path = path  # relaunches warm onto the new one
+        return report
+
+    # ----------------------------------------------------------- reporting
+    def engine(self, index: int = 0):
+        """The live engine in slot ``index`` (current incarnation)."""
+        rep = self._slots[index].replica
+        if rep is None:
+            raise KeyError(f"slot {index} has no replica")
+        return rep.engine
+
+    @property
+    def states(self) -> Dict[int, str]:
+        return {s.index: (s.replica.state if s.replica else "empty")
+                for s in self._slots}
+
+    @property
+    def retraces_post_warmup(self) -> int:
+        """Pool-wide retraces since each LIVE replica's warmup baseline —
+        the serve-load smoke's zero-retrace gate (ejected incarnations are
+        out of the pool and out of the count)."""
+        return sum(s.replica.retraces_post_warmup for s in self._slots
+                   if s.replica and s.replica.state != "ejected")
+
+    def snapshot(self) -> Dict:
+        """Router + per-replica metrics, JSON-ready (the
+        ``results/serve_load_smoke.json`` building block)."""
+        return {
+            "router": self.metrics.snapshot(),
+            "replicas": {
+                str(s.index): {
+                    "state": s.replica.state if s.replica else "empty",
+                    "batches": s.replica.batches if s.replica else 0,
+                    "retraces_post_warmup":
+                        s.replica.retraces_post_warmup if s.replica else 0,
+                    **s.metrics.snapshot(),
+                    "engine": (s.replica.engine.metrics.snapshot()
+                               if s.replica else None),
+                }
+                for s in self._slots
+            },
+        }

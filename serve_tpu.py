@@ -10,8 +10,24 @@ Interactive (default): reads one UTF-8 text per line on stdin, prints
 would own.  Offline: ``--input FILE`` scores a whole file at maximum
 throughput and writes predictions to ``--output`` (or stdout).
 
+``--replicas N`` (N > 1) serves through the fault-tolerant
+:class:`~pdnlp_tpu.serve.router.ReplicaRouter`: N engine replicas — one per
+device group when enough devices exist, independent single-device engines
+otherwise — behind tiered admission control (backpressure -> shed ->
+reject), least-loaded dispatch, heartbeat health ejection with requeue, and
+warmup-gated reintegration.  ``--replicas 1`` (default) is the original
+single-engine ``DynamicBatcher`` path, byte-for-byte.
+
+Graceful shutdown: SIGTERM/SIGINT stop intake, drain the in-flight window
+(every accepted request is completed or deadline-failed — never silently
+dropped), and flush the metrics snapshot + trace span files before exit.
+
     # online: serve stdin lines through the batcher
     python serve_tpu.py --checkpoint output/dp-cls.msgpack
+
+    # online, 4 fault-tolerant replicas with 200ms deadlines
+    python serve_tpu.py --checkpoint output/dp-cls.msgpack \
+        --replicas 4 --deadline_ms 200
 
     # offline: score a file, dump metrics
     python serve_tpu.py --checkpoint output/dp-cls.msgpack \
@@ -19,16 +35,20 @@ throughput and writes predictions to ``--output`` (or stdout).
 
 Serve-local flags (not ``Args`` fields): ``--checkpoint`` (default: newest
 under ``--output_dir``), ``--buckets 32,64,128``, ``--max_batch_size``,
-``--max_wait_ms``, ``--max_queue``, ``--deadline_ms``, ``--input``,
-``--output``, ``--metrics_path``, ``--no_mesh``.  Everything else (model,
-dtype, vocab, output_dir, ...) is the standard ``Args`` CLI.
+``--max_wait_ms``, ``--max_queue``, ``--deadline_ms``, ``--replicas``,
+``--hedge_ms``, ``--replica_stall_s``, ``--input``, ``--output``,
+``--metrics_path``, ``--no_mesh``.  Everything else (model, dtype, vocab,
+output_dir, ...) is the standard ``Args`` CLI.
 """
 from __future__ import annotations
 
+import signal
 import sys
 from typing import Optional
 
-from pdnlp_tpu.serve import DEFAULT_BUCKETS, DynamicBatcher, InferenceEngine
+from pdnlp_tpu.serve import (
+    DEFAULT_BUCKETS, DynamicBatcher, InferenceEngine, ReplicaRouter,
+)
 from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
 from pdnlp_tpu.utils.logging import rank0_print
 
@@ -48,9 +68,7 @@ def build_engine(args: Args, *, checkpoint: Optional[str] = None,
         mesh = make_mesh(num_devices=args.num_devices, shape=args.mesh_shape)
     engine = InferenceEngine(args, mesh=mesh)
     if checkpoint is None:
-        from pdnlp_tpu.train import checkpoint as ckpt
-
-        checkpoint = ckpt.latest(args.output_dir)
+        checkpoint = _latest_checkpoint(args)
     if checkpoint:
         engine.load_checkpoint(checkpoint)
         rank0_print(f"serving {checkpoint}", file=sys.stderr)
@@ -58,6 +76,85 @@ def build_engine(args: Args, *, checkpoint: Optional[str] = None,
         rank0_print("WARNING: no checkpoint found — serving untrained "
                     "init weights (smoke mode)", file=sys.stderr)
     return engine
+
+
+def _latest_checkpoint(args: Args) -> Optional[str]:
+    from pdnlp_tpu.train import checkpoint as ckpt
+
+    return ckpt.latest(args.output_dir)
+
+
+def build_router(args: Args, replicas: int, *,
+                 checkpoint: Optional[str] = None, use_mesh: bool = True,
+                 buckets=DEFAULT_BUCKETS, max_batch_size: int = 8,
+                 max_wait_ms: float = 5.0, max_queue: int = 256,
+                 deadline_ms: Optional[float] = None,
+                 hedge_ms: Optional[float] = None,
+                 stall_timeout: float = 10.0) -> ReplicaRouter:
+    """N replica engines behind the fault-tolerant router.
+
+    Placement: when the host exposes at least ``replicas`` devices (and
+    meshes are allowed), devices split into ``replicas`` contiguous groups
+    and each engine gets a private data-parallel mesh slice — independent
+    device streams, so one wedged replica cannot stall the others.  With
+    fewer devices (CPU tests), each replica is an independent plain-jit
+    engine.  The same factory rebuilds an ejected replica's engine on
+    :meth:`ReplicaRouter.relaunch`.
+    """
+    import jax
+
+    groups: list = [None] * replicas
+    if use_mesh:
+        from pdnlp_tpu.parallel import make_mesh
+
+        devices = list(jax.devices())
+        if args.num_devices:
+            devices = devices[: args.num_devices]
+        per = len(devices) // replicas
+        if per >= 1:
+            groups = [make_mesh(devices=devices[i * per:(i + 1) * per])
+                      for i in range(replicas)]
+
+    # ONE tokenizer for the whole pool: each engine would otherwise
+    # re-read the vocab at construction — and again on every relaunch,
+    # inflating the recovery path for no reason
+    from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, get_or_build_vocab
+
+    tok = WordPieceTokenizer(get_or_build_vocab(args))
+
+    def factory(index: int) -> InferenceEngine:
+        return InferenceEngine(args, tokenizer=tok, mesh=groups[index])
+
+    if checkpoint is None:
+        checkpoint = _latest_checkpoint(args)
+    engines = [factory(i) for i in range(replicas)]
+    if checkpoint:
+        rank0_print(f"serving {checkpoint} on {replicas} replicas",
+                    file=sys.stderr)
+    else:
+        rank0_print("WARNING: no checkpoint found — serving untrained "
+                    "init weights (smoke mode)", file=sys.stderr)
+    return ReplicaRouter(
+        engines, engine_factory=factory, buckets=buckets,
+        max_batch_size=max_batch_size, max_wait_ms=max_wait_ms,
+        max_queue=max_queue, default_deadline_ms=deadline_ms,
+        hedge_ms=hedge_ms, stall_timeout=stall_timeout,
+        checkpoint_path=checkpoint, tracer=engines[0].tracer)
+
+
+class _ShutdownRequested(KeyboardInterrupt):
+    """SIGTERM/SIGINT: stop intake, drain, flush — never drop silently."""
+
+
+def _install_signal_handlers() -> None:
+    def _on_signal(signum, frame):
+        raise _ShutdownRequested(signal.Signals(signum).name)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except ValueError:  # non-main thread (embedded use): skip
+            return
 
 
 def main(argv=None) -> None:
@@ -68,6 +165,9 @@ def main(argv=None) -> None:
     argv, max_wait = pop_cli_flag(argv, "--max_wait_ms", 5.0, float)
     argv, max_queue = pop_cli_flag(argv, "--max_queue", 256, int)
     argv, deadline = pop_cli_flag(argv, "--deadline_ms", None, float)
+    argv, replicas = pop_cli_flag(argv, "--replicas", 1, int)
+    argv, hedge_ms = pop_cli_flag(argv, "--hedge_ms", None, float)
+    argv, stall_s = pop_cli_flag(argv, "--replica_stall_s", 10.0, float)
     argv, in_path = pop_cli_flag(argv, "--input")
     argv, out_path = pop_cli_flag(argv, "--output")
     argv, metrics_path = pop_cli_flag(argv, "--metrics_path")
@@ -80,78 +180,127 @@ def main(argv=None) -> None:
 
     from pdnlp_tpu.data.corpus import id2label
 
-    engine = build_engine(args, checkpoint=checkpoint, use_mesh=not no_mesh)
+    _install_signal_handlers()
+
+    router = None
+    if replicas > 1 and not in_path:
+        router = build_router(
+            args, replicas, checkpoint=checkpoint, use_mesh=not no_mesh,
+            buckets=buckets, max_batch_size=max_batch, max_wait_ms=max_wait,
+            max_queue=max_queue, deadline_ms=deadline, hedge_ms=hedge_ms,
+            stall_timeout=stall_s)
+        engine = router.engine(0)  # metrics/tracer anchor
+    else:
+        engine = build_engine(args, checkpoint=checkpoint,
+                              use_mesh=not no_mesh)
+
+    def flush_artifacts(extra=None) -> None:
+        """Metrics snapshot + trace spans land on disk on EVERY exit path
+        — a drained shutdown that loses its telemetry only half happened."""
+        import json
+
+        snap = router.snapshot() if router is not None \
+            else engine.metrics.snapshot()
+        if extra:
+            snap = {**snap, **extra}
+        if metrics_path:
+            from pdnlp_tpu.serve.metrics import _save_json
+
+            _save_json(snap, metrics_path)
+            rank0_print(f"metrics snapshot -> {metrics_path}",
+                        file=sys.stderr)
+        else:
+            rank0_print(json.dumps(snap, indent=2), file=sys.stderr)
+        trace_path = engine.tracer.flush()
+        if trace_path:
+            rank0_print(f"[obs] spans -> {trace_path}", file=sys.stderr)
 
     if in_path:  # offline: whole-file throughput path
         from pdnlp_tpu.serve.offline import score_file
 
-        texts, preds, _ = score_file(engine, in_path, buckets=buckets,
-                                     batch_size=max_batch)
-        out = open(out_path, "w", encoding="utf-8") if out_path else sys.stdout
         try:
-            for text, p in zip(texts, preds):
-                out.write(f"{int(p)}\t{id2label[int(p)]}\t{text}\n")
+            texts, preds, _ = score_file(engine, in_path, buckets=buckets,
+                                         batch_size=max_batch)
+            out = open(out_path, "w", encoding="utf-8") if out_path \
+                else sys.stdout
+            try:
+                for text, p in zip(texts, preds):
+                    out.write(f"{int(p)}\t{id2label[int(p)]}\t{text}\n")
+            finally:
+                if out_path:
+                    out.close()
+            rank0_print(f"scored {len(texts)} texts", file=sys.stderr)
         finally:
-            if out_path:
-                out.close()
-        rank0_print(f"scored {len(texts)} texts", file=sys.stderr)
-    else:  # online: stdin lines through the dynamic batcher
-        with DynamicBatcher(engine, buckets=buckets,
-                            max_batch_size=max_batch, max_wait_ms=max_wait,
-                            max_queue=max_queue,
-                            default_deadline_ms=deadline) as batcher:
-            # warmup over the batcher's OWN clamped bucket list: one
-            # definition of "usable" (batcher.usable_buckets), zero drift
-            engine.warmup(batcher.buckets, engine.pad_rows(max_batch))
-            rank0_print("ready — one text per line on stdin "
-                        "(EOF to exit)", file=sys.stderr)
+            flush_artifacts()
+        return
 
-            # pipelined: keep a window of requests in flight so the batcher
-            # can actually form multi-row batches (submit-then-block per
-            # line would hold queue depth at 1 and micro-batching would
-            # never engage); results still print in input order
-            from collections import deque
-
-            window = 2 * batcher.max_batch_size
-            inflight: deque = deque()
-
-            def emit(fut) -> None:
-                try:
-                    logits = fut.result(timeout=60)
-                except Exception as e:  # noqa: BLE001 — QueueFullError,
-                    # DeadlineExceeded, engine failure: report, keep serving
-                    print(f"ERROR\t{type(e).__name__}: {e}", flush=True)
-                    return
-                p = int(logits.argmax())
-                print(f"{p}\t{id2label[p]}", flush=True)
-
-            for line in sys.stdin:
-                text = line.strip()
-                if not text:
-                    continue
-                try:
-                    inflight.append(batcher.submit(text))
-                except Exception as e:  # noqa: BLE001 — queue full: report
-                    print(f"ERROR\t{type(e).__name__}: {e}", flush=True)
-                    continue
-                while len(inflight) >= window:
-                    emit(inflight.popleft())
-            while inflight:
-                emit(inflight.popleft())
-
-    if metrics_path:
-        engine.metrics.save(metrics_path)
-        rank0_print(f"metrics snapshot -> {metrics_path}", file=sys.stderr)
+    # online: stdin lines through the dynamic batcher (or the router)
+    if router is not None:
+        frontend = router.start()
+        if not router.wait_ready():
+            frontend.stop(drain=False)
+            sys.exit("serve_tpu: no replica finished warmup — the pool is "
+                     "dead (corrupt checkpoint? every worker's warm load "
+                     "failed?); refusing to serve nothing")
     else:
-        import json
+        frontend = DynamicBatcher(
+            engine, buckets=buckets, max_batch_size=max_batch,
+            max_wait_ms=max_wait, max_queue=max_queue,
+            default_deadline_ms=deadline).start()
+        # warmup over the batcher's OWN clamped bucket list: one
+        # definition of "usable" (batcher.usable_buckets), zero drift
+        engine.warmup(frontend.buckets, engine.pad_rows(max_batch))
+    rank0_print("ready — one text per line on stdin "
+                "(EOF to exit)", file=sys.stderr)
 
-        rank0_print(json.dumps(engine.metrics.snapshot(), indent=2),
-                    file=sys.stderr)
-    # --trace true: the ring buffer means nothing unless it lands on disk
-    # — the trainer flushes at end-of-train, the serve CLI flushes here
-    trace_path = engine.tracer.flush()
-    if trace_path:
-        rank0_print(f"[obs] spans -> {trace_path}", file=sys.stderr)
+    # pipelined: keep a window of requests in flight so the batcher can
+    # actually form multi-row batches (submit-then-block per line would
+    # hold queue depth at 1 and micro-batching would never engage);
+    # results still print in input order
+    from collections import deque
+
+    # the window must scale with the POOL's batch appetite: N replicas
+    # each flushing a PADDED batch (flush_rows, the mesh data-axis
+    # multiple) need N x that depth in flight before size-triggered
+    # batching can engage on any one of them; the single-replica
+    # batcher's max_batch_size is already padded in its __init__
+    window = 2 * (replicas * router.engine(0).pad_rows(max_batch)
+                  if router is not None else frontend.max_batch_size)
+    inflight: deque = deque()
+
+    def emit(fut) -> None:
+        try:
+            logits = fut.result(timeout=60)
+        except Exception as e:  # noqa: BLE001 — QueueFullError,
+            # DeadlineExceeded, engine failure: report, keep serving
+            print(f"ERROR\t{type(e).__name__}: {e}", flush=True)
+            return
+        p = int(logits.argmax())
+        print(f"{p}\t{id2label[p]}", flush=True)
+
+    try:
+        for line in sys.stdin:
+            text = line.strip()
+            if not text:
+                continue
+            try:
+                inflight.append(frontend.submit(text))
+            except Exception as e:  # noqa: BLE001 — queue full: report
+                print(f"ERROR\t{type(e).__name__}: {e}", flush=True)
+                continue
+            while len(inflight) >= window:
+                emit(inflight.popleft())
+    except _ShutdownRequested as e:
+        rank0_print(f"[serve] {e} — draining {len(inflight)} in-flight "
+                    "request(s), then shutting down", file=sys.stderr)
+    finally:
+        # graceful shutdown: every accepted request is completed or
+        # deadline-failed through emit() — never silently dropped — then
+        # the frontend drains its queues and telemetry hits disk
+        while inflight:
+            emit(inflight.popleft())
+        frontend.stop(drain=True)
+        flush_artifacts()
 
 
 if __name__ == "__main__":

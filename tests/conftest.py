@@ -31,6 +31,13 @@ except AttributeError:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy real-process cases (chaos storms, subprocess servers) "
+        "excluded from tier-1 (`-m 'not slow'`)")
+
+
 @pytest.fixture(scope="session")
 def ndev():
     return jax.device_count()

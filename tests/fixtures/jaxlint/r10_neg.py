@@ -1,0 +1,32 @@
+"""R10 negatives: spanned fetches, the Tracer.block barrier, and blocking
+on values that are not dispatch results."""
+import jax
+
+from pdnlp_tpu.serve.engine import InferenceEngine  # noqa: F401
+
+
+def dispatch_spanned(engine, batch, tracer):
+    # the engine idiom: the fetch IS the completion barrier, inside a span
+    with tracer.span("forward", rows=8):
+        logits = engine._jit_forward(engine.params, batch)
+        return jax.device_get(logits)
+
+
+def dispatch_tracer_block(engine, batch, tracer):
+    # Tracer.block wraps block_until_ready in its own device_block span —
+    # no raw fetch appears, nothing to flag
+    logits = engine._jit_forward(engine.params, batch)
+    return tracer.block(logits)
+
+
+def host_side_results(engine, ids):
+    # infer_ids returns host numpy (the engine blocked internally, inside
+    # its span); fetching it again is a no-op, not a hidden device wait
+    out = engine.infer_ids(ids, 32)
+    return jax.device_get(out)
+
+
+def unrelated_fetch(summary):
+    # blocking a non-dispatch value is R4's business (timing windows),
+    # never R10's
+    return jax.device_get(summary)

@@ -1,0 +1,26 @@
+"""R10 positives: serve dispatch paths blocking on device results with no
+tracer span around the fetch."""
+import jax
+
+from pdnlp_tpu.serve.engine import InferenceEngine  # noqa: F401
+
+
+def dispatch(engine, batch):
+    logits = engine._jit_forward(engine.params, batch)
+    return jax.device_get(logits)
+
+
+def dispatch_inline(engine, batch):
+    return jax.device_get(engine._jit_forward(engine.params, batch))
+
+
+def dispatch_barrier(engine, batch):
+    out = engine._jit_forward(engine.params, batch)
+    jax.block_until_ready(out)
+    return out
+
+
+def dispatch_method_barrier(engine, batch):
+    out = engine._jit_forward(engine.params, batch)
+    out.block_until_ready()
+    return out

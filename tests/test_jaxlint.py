@@ -158,6 +158,36 @@ def test_r9_hint_points_at_the_async_saver():
     assert "async_ckpt" in f.hint and "submit" in f.hint
 
 
+def test_r10_unspanned_serve_block_positive():
+    # var fetch (10), inline fetch (14), block_until_ready call (19),
+    # .block_until_ready() method (25) — all on _jit_forward results
+    assert all_hits("r10_pos.py") == [("R10", 10), ("R10", 14),
+                                      ("R10", 19), ("R10", 25)]
+
+
+def test_r10_unspanned_serve_block_negative():
+    assert hits("r10_neg.py", "R10") == []
+
+
+def test_r10_requires_serve_context(tmp_path):
+    """Modules outside the serve surface (no pdnlp_tpu.serve import, not
+    under pdnlp_tpu/serve/) are R4's territory, never R10's."""
+    p = tmp_path / "plain.py"
+    p.write_text("import jax\n\n"
+                 "def f(jit_forward, x):\n"
+                 "    out = jit_forward(x)\n"
+                 "    return jax.device_get(out)\n")
+    assert [f for f in analyze_paths([str(p)], root=str(tmp_path))
+            if f.rule_id == "R10"] == []
+
+
+def test_r10_hint_names_the_tracer():
+    path = os.path.join(FIXTURES, "r10_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R10"][0]
+    assert "span" in f.hint and "pdnlp_tpu.obs" in f.hint
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -167,8 +197,9 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    assert list(all_rules()) == ["R1", "R2", "R3", "R4", "R5", "R6", "R7",
-                                 "R8", "R9"]
+    # the registry sorts by id STRING (R10 between R1 and R2)
+    assert list(all_rules()) == ["R1", "R10", "R2", "R3", "R4", "R5", "R6",
+                                 "R7", "R8", "R9"]
 
 
 # -------------------------------------------------------------- suppressions
