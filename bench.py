@@ -220,12 +220,25 @@ def serve_load_smoke(argv) -> None:
       walk ALL admission tiers: backpressure waits, shed-lowest-slack, and
       hard rejects, each recorded per tier.
 
+    Then a **packed phase** (PR 9): the same seeded short-request storm
+    (every request well under 64 tokens — the Chinese-emotion query shape)
+    run CLOSED-LOOP twice over fresh pools, once padded
+    (``serve_pack=off``) and once packed (``serve_pack=on``), with a
+    mid-storm replica kill + relaunch on the packed run.  Gates: packed
+    real-token throughput >= ``--serve_pack_ratio`` x the padded path,
+    per-request logit parity between the runs (exact argmax where the
+    padded top-2 margin is meaningful, max |diff| under 1e-3), token-level
+    fill >= ``--serve_pack_fill``, ZERO post-warmup retraces on both pools
+    (the packed path holds ONE compiled shape), and zero lost accepted
+    requests through the kill.
+
     Gates (non-zero exit on any violation): zero LOST accepted requests (a
     request may succeed or deadline-fail, never vanish or surface a replica
     error), p99 latency at the target QPS under ``--serve_load_p99_ms``,
     zero post-warmup retraces across the pool, ejection-to-recovery under
     ``--serve_load_recovery_s``, a completed rolling swap with zero
-    rollbacks, and every admission tier engaged during the burst.
+    rollbacks, every admission tier engaged during the burst, and the
+    packed-phase gates above.
     Snapshot: ``results/serve_load_smoke.json``.  Deterministic and
     CPU-safe like ``--serve`` (synthesized texts, seeded arrivals).
     """
@@ -253,6 +266,15 @@ def serve_load_smoke(argv) -> None:
                                         20.0, float)
     argv, deadline_ms = pop_cli_flag(argv, "--serve_load_deadline_ms",
                                      8000.0, float)
+    # 3600 requests: long enough that steady-state budget flushes dominate
+    # the fill/throughput numbers over the timing-driven partials (ramp,
+    # kill hop, tail) — the gates need headroom on a loaded CI host, not
+    # a photo finish
+    argv, pack_n = pop_cli_flag(argv, "--serve_pack_requests", 3600, int)
+    argv, pack_ratio_floor = pop_cli_flag(argv, "--serve_pack_ratio", 1.5,
+                                          float)
+    argv, pack_fill_floor = pop_cli_flag(argv, "--serve_pack_fill", 0.85,
+                                         float)
     argv, out_path = pop_cli_flag(
         argv, "--serve_load_out",
         os.path.join("results", "serve_load_smoke.json"))
@@ -302,10 +324,16 @@ def serve_load_smoke(argv) -> None:
             print(f"checkpoint {ckpt_path} not loadable ({exc}); "
                   "serving init weights", file=sys.stderr)
             ckpt_path = None
+    # the main storm/burst pins the PADDED path: its tier gates (burst
+    # sized at max_queue*3 REQUESTS) are calibrated in request units, and
+    # on TPU `auto` would resolve packed and rescale admission to token
+    # units out from under them — the packed phase below pins its own
+    # modes explicitly
     router = ReplicaRouter(
         engines, engine_factory=factory, buckets=buckets,
         max_batch_size=batch_size, max_wait_ms=5.0, max_queue=max_queue,
         backpressure_wait_ms=10.0, default_deadline_ms=deadline_ms,
+        serve_pack="off",
         stall_timeout=2.0, poll_interval=0.05, checkpoint_path=ckpt_path)
     router.start()
     if not router.wait_ready(600):
@@ -437,6 +465,133 @@ def serve_load_smoke(argv) -> None:
     router.stop(drain=False)
     adm = snap["router"]["admission"]
     retraces_post = router.retraces_post_warmup
+
+    # ---- packed phase: short-request storm, packed vs padded pools ----
+    # the throughput half of ROADMAP item 1: every request is well under
+    # 64 tokens (the dominant production shape), so the padded path burns
+    # most of each forward on [PAD] while the packed path bin-packs many
+    # requests per 128-token row.  Closed-loop (window-bounded) submission
+    # over the SAME seeded request sequence measures pool capacity; the
+    # packed run also absorbs a mid-storm kill + relaunch.
+    prng = random.Random(args.seed + 1)
+    short_lengths = [4, 7, 10, 14, 18, 22]  # chars -> ~6..24 tokens
+    ptexts = ["".join(prng.choice(chars)
+                      for _ in range(short_lengths[i % len(short_lengths)]))
+              for i in range(pack_n)]
+    pids = [tok.encode_ids(t, max(buckets)) for t in ptexts]
+    pack_tokens = sum(len(i) for i in pids)
+    mean_tok = pack_tokens / max(1, len(pids))
+
+    def run_pack_storm(mode: str, kill: bool) -> dict:
+        engines2 = [factory(i) for i in range(n_replicas)]
+        flush_tokens = engines2[0].pad_rows(batch_size) * max(buckets)
+        if mode == "on":  # window ~= 2 packed flushes per replica, in
+            per_rep = max(1, int(flush_tokens / mean_tok))  # request units
+        else:
+            per_rep = engines2[0].pad_rows(batch_size)
+        window = 2 * n_replicas * per_rep
+        # a 25ms age bound (vs the storm's 5ms): the phase is deadline-
+        # free and throughput-gated, so partial aged flushes at the ramp,
+        # the kill hop, and the tail should not eat the fill number
+        r2 = ReplicaRouter(
+            engines2, engine_factory=factory, buckets=buckets,
+            max_batch_size=batch_size, max_wait_ms=25.0,
+            max_queue=4 * window, serve_pack=mode, stall_timeout=2.0,
+            poll_interval=0.05, checkpoint_path=ckpt_path)
+        r2.start()
+        if not r2.wait_ready(600):
+            sys.exit(f"serve-load smoke FAILED: packed-phase pool "
+                     f"(serve_pack={mode}) never finished warmup")
+        victim2 = n_replicas - 1
+        kill_at, relaunch_at = pack_n // 3, (2 * pack_n) // 3
+        from collections import deque
+
+        futs2: list = [None] * pack_n
+        inflight: deque = deque()
+        lost = 0
+        t0 = time.monotonic()
+        for i, ids in enumerate(pids):
+            if kill and i == kill_at:
+                r2.kill_replica(victim2, "crash")
+            if kill and i == relaunch_at:
+                t_eject = time.monotonic() + 5.0
+                while r2.states[victim2] != "ejected" \
+                        and time.monotonic() < t_eject:
+                    time.sleep(0.01)
+                r2.relaunch(victim2)
+            # deadline-free submits: the admission ladder never sheds
+            # deadline-free work, so every request must complete — any
+            # exception (queue-full would mean a mis-sized window) is LOST
+            futs2[i] = r2.submit_ids(list(ids))
+            inflight.append(i)
+            while len(inflight) >= window:
+                j = inflight.popleft()
+                try:
+                    futs2[j] = futs2[j].result(timeout=120)
+                except Exception:  # noqa: BLE001
+                    futs2[j] = None
+        while inflight:
+            j = inflight.popleft()
+            try:
+                futs2[j] = futs2[j].result(timeout=120)
+            except Exception:  # noqa: BLE001
+                futs2[j] = None
+        elapsed = time.monotonic() - t0
+        lost = sum(1 for f in futs2 if f is None)
+        if kill and not r2.wait_ready(300):
+            sys.exit("serve-load smoke FAILED: packed-phase relaunch "
+                     "never finished its reintegration warmup")
+        snap2 = r2.snapshot()
+        fills = [s["fill_ratio"] for s in snap2["replicas"].values()]
+        fill_n = sum(f["count"] for f in fills)
+        fill_mean = (sum((f["mean"] or 0.0) * f["count"] for f in fills)
+                     / fill_n if fill_n else None)
+        retr = r2.retraces_post_warmup
+        r2.stop(drain=False)
+        return {
+            "serve_pack": mode,
+            "requests": pack_n,
+            "real_tokens": pack_tokens,
+            "elapsed_s": round(elapsed, 3),
+            "tokens_per_s": round(pack_tokens / elapsed, 1),
+            "requests_per_s": round(pack_n / elapsed, 1),
+            "window": window,
+            "lost": lost,
+            "fill_mean": (round(fill_mean, 4)
+                          if fill_mean is not None else None),
+            "batches": sum(s["batches_total"]
+                           for s in snap2["replicas"].values()),
+            "retraces_post_warmup": retr,
+            "kill": ({"victim": victim2,
+                      "ejections": snap2["router"]["ejections_total"],
+                      "requeued": snap2["router"]["requeued_total"],
+                      "retries": snap2["router"]["retries_total"]}
+                     if kill else None),
+            "_logits": futs2,
+        }
+
+    padded_run = run_pack_storm("off", kill=False)
+    packed_run = run_pack_storm("on", kill=True)
+    # per-request parity between the two runs: exact argmax wherever the
+    # padded top-2 margin is meaningful (offset segments reduce over
+    # shifted key indices -> ulp-level drift, never semantic), tight
+    # absolute bound everywhere
+    import numpy as np
+
+    parity = {"compared": 0, "argmax_mismatch": 0, "max_abs_diff": 0.0}
+    for a, b in zip(padded_run.pop("_logits"), packed_run.pop("_logits")):
+        if a is None or b is None:
+            continue
+        parity["compared"] += 1
+        parity["max_abs_diff"] = max(parity["max_abs_diff"],
+                                     float(np.abs(a - b).max()))
+        top2 = np.sort(a)[-2:]
+        if np.argmax(a) != np.argmax(b) and top2[1] - top2[0] > 1e-4:
+            parity["argmax_mismatch"] += 1
+    parity["max_abs_diff"] = round(parity["max_abs_diff"], 9)
+    pack_ratio = (packed_run["tokens_per_s"]
+                  / max(1e-9, padded_run["tokens_per_s"]))
+
     result = {
         "metric": "serve_load_smoke",
         "requests": n_requests,
@@ -470,6 +625,14 @@ def serve_load_smoke(argv) -> None:
         "retraces_post_warmup": retraces_post,
         "burst": {"requests": 3 * (burst_n // 3), **burst_outcomes},
         "admission": adm,
+        "packed_phase": {
+            "padded": padded_run,
+            "packed": packed_run,
+            "tokens_throughput_ratio": round(pack_ratio, 2),
+            "ratio_floor": pack_ratio_floor,
+            "fill_floor": pack_fill_floor,
+            "parity": parity,
+        },
         "checkpoint": ckpt_path,
         "model": args.model,
         "serve_dtype": router.engine(0).dtype_label,
@@ -517,6 +680,38 @@ def serve_load_smoke(argv) -> None:
         if adm[tier] < 1:
             failures.append(f"admission tier {tier!r} never engaged "
                             f"during the burst ({adm})")
+    # ---- packed-phase gates ----
+    if pack_ratio < pack_ratio_floor:
+        failures.append(
+            f"packed tokens-throughput {packed_run['tokens_per_s']}/s is "
+            f"only {pack_ratio:.2f}x the padded path "
+            f"({padded_run['tokens_per_s']}/s) — floor "
+            f"{pack_ratio_floor}x at the short-request mix")
+    if parity["argmax_mismatch"] or parity["max_abs_diff"] > 1e-3:
+        failures.append(f"packed-vs-padded per-request parity broken: "
+                        f"{parity}")
+    if parity["compared"] < pack_n:
+        failures.append(f"parity compared only {parity['compared']}"
+                        f"/{pack_n} requests (lost futures?)")
+    if packed_run["fill_mean"] is None \
+            or packed_run["fill_mean"] < pack_fill_floor:
+        failures.append(f"packed fill {packed_run['fill_mean']} under the "
+                        f"{pack_fill_floor} floor")
+    if packed_run["retraces_post_warmup"] \
+            or padded_run["retraces_post_warmup"]:
+        failures.append(
+            "packed-phase post-warmup retraces (packed "
+            f"{packed_run['retraces_post_warmup']}, padded "
+            f"{padded_run['retraces_post_warmup']}) — the packed path "
+            "must hold ONE compiled shape")
+    if packed_run["lost"] or padded_run["lost"]:
+        failures.append(f"packed phase LOST requests through the kill "
+                        f"(packed {packed_run['lost']}, padded "
+                        f"{padded_run['lost']})")
+    pk = packed_run["kill"]
+    if pk["ejections"] < 1 or pk["requeued"] + pk["retries"] < 1:
+        failures.append("the packed-phase kill stranded no work — "
+                        f"eject/re-pack was never exercised ({pk})")
 
     if out_path:
         os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)

@@ -14,4 +14,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r8_xla_attention,
     r9_blocking_ckpt,
     r10_unspanned_serve_block,
+    r11_unpacked_serve_forward,
 )

@@ -1,0 +1,116 @@
+"""R11 — packed-routed serve forwards built without the segment channels.
+
+The packed serving path (PR 9) holds a contract with the kernel layer: when
+a serve scope routes *pallas-segmented* attention (``ops.attention.
+routed_impl(..., segmented=True)`` / the engine's ``routed_attn(seq,
+segmented=True)``), the batch it feeds the jitted forward must carry the
+packed channels — ``segment_ids`` (the in-kernel block-diagonal mask) and
+``cls_positions`` (the per-segment [CLS] gather).  A forward built from the
+bare padded trio (``input_ids``/``attention_mask``/``token_type_ids``) in
+such a scope silently serves the WRONG program: the kernel sees no segment
+IDs, packed rows cross-attend, and every co-packed request's logits are
+garbage — a corruption no retrace counter or latency gate catches.
+
+Heuristic, per scope, serve modules only (same gate as R10): if the scope
+calls a ``routed_*``-shaped function with the constant keyword
+``segmented=True``, then every batch-dict construction in the scope whose
+STATICALLY-known keys include ``input_ids`` must also include both packed
+channels.  Keys are read from dict literals and from dict comprehensions
+over an inline constant tuple/list; a dict whose keys cannot be resolved
+statically (e.g. the engine's ``PACKED_CHANNELS`` class-attribute
+comprehension) is out of scope — the rule flags provable omissions, not
+unknowns.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from pdnlp_tpu.analysis.core import Finding, ModuleInfo, Rule, register
+
+_PACKED_CHANNELS = {"segment_ids", "cls_positions"}
+
+
+def _routed_shaped(name: str) -> bool:
+    return name.split(".")[-1].lower().startswith("routed_")
+
+
+def _static_keys(node: ast.AST) -> Optional[Set[str]]:
+    """The dict construction's key set when statically known, else None."""
+    if isinstance(node, ast.Dict):
+        keys: Set[str] = set()
+        for k in node.keys:
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                return None  # **spread or computed key: unknowable
+            keys.add(k.value)
+        return keys
+    if isinstance(node, ast.DictComp) and len(node.generators) == 1:
+        it = node.generators[0].iter
+        if isinstance(it, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in it.elts):
+            return {e.value for e in it.elts}
+    return None
+
+
+@register
+class UnpackedServeForward(Rule):
+    rule_id = "R11"
+    name = "unpacked-serve-forward"
+    hint = ("a scope that routes pallas-segmented attention (segmented="
+            "True) must feed the forward the packed channels — build the "
+            "batch with segment_ids + cls_positions (data.packing."
+            "pack_id_lists / InferenceEngine.PACKED_CHANNELS), or route "
+            "unsegmented for the padded path; a segment-routed forward "
+            "without segment IDs serves cross-attending packed rows")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not self._serve_module(mod):
+            return
+        for _, scope_node, body in mod.scopes():
+            yield from self._check_scope(mod, scope_node, body)
+
+    @staticmethod
+    def _serve_module(mod: ModuleInfo) -> bool:
+        if "pdnlp_tpu/serve/" in mod.path:
+            return True
+        return any(v.startswith("pdnlp_tpu.serve")
+                   for v in mod.aliases.values())
+
+    def _check_scope(self, mod: ModuleInfo, scope_node, body
+                     ) -> Iterator[Finding]:
+        own = [n for stmt in body for n in ast.walk(stmt)
+               if self._in_scope(mod, scope_node, n)]
+        if not any(self._segmented_route(n) for n in own):
+            return
+        for node in own:
+            keys = _static_keys(node)
+            if keys is None or "input_ids" not in keys:
+                continue
+            missing = sorted(_PACKED_CHANNELS - keys)
+            if missing:
+                yield self.finding(
+                    mod, node,
+                    "forward batch built without the packed channels "
+                    f"({'/'.join(missing)}) in a scope that routes "
+                    "pallas-segmented attention — the kernel would serve "
+                    "packed rows with no block-diagonal mask")
+
+    @staticmethod
+    def _segmented_route(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) \
+            else fn.id if isinstance(fn, ast.Name) else ""
+        if not _routed_shaped(name):
+            return False
+        return any(kw.arg == "segmented"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True for kw in node.keywords)
+
+    def _in_scope(self, mod: ModuleInfo, scope_node, node) -> bool:
+        fn = mod.enclosing_function(node)
+        if isinstance(scope_node, ast.Module):
+            return fn is None
+        return fn is scope_node or node is scope_node

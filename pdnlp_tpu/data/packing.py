@@ -15,7 +15,7 @@ Shapes stay fully static: one (num_rows, S) int32 array per channel.
 from __future__ import annotations
 
 import bisect
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -164,6 +164,86 @@ def pack_classification(encoded: EncodedDataset, max_segments: int = 16
                         ) -> PackedClassificationDataset:
     """Pack an encoded classification split into multi-example rows."""
     return PackedClassificationDataset(encoded, max_segments=max_segments)
+
+
+def pack_id_lists(
+    id_lists: Sequence[Sequence[int]],
+    seq_len: int,
+    rows: int,
+    max_segments: int,
+    pad_id: int = 0,
+) -> Tuple[Dict[str, np.ndarray], List[Optional[Tuple[int, int]]]]:
+    """Bin-pack ragged token-id lists into ONE fixed ``[rows, seq_len]``
+    packed batch — the online-serving twin of
+    :class:`PackedClassificationDataset` (same channel layout, so
+    ``models.bert.classify`` and the pallas segment kernel consume it
+    unchanged), minus the label/weight channels serving never has.
+
+    The caller's order IS the priority order (the serve batcher sorts by
+    remaining deadline slack, lowest first, so the most urgent requests
+    close the earliest rows): placement is first-fit over the open rows in
+    order, and a list that fits nowhere right now is *skipped* — it could
+    not ride this batch anyway — while later, shorter lists may still fill
+    the gaps it left.
+
+    Returns ``(batch, placements)`` where ``placements[i]`` is the
+    ``(row, slot)`` the ``i``-th list landed at, or ``None`` if it did not
+    fit (the caller keeps it queued for the next batch).  ``batch`` always
+    has the full ``rows`` x ``seq_len`` shape (unused rows stay padding)
+    so the packed forward is one compiled program per ``(rows, seq_len)``
+    — retrace-free by construction.
+    """
+    S, R, M = int(seq_len), int(rows), int(max_segments)
+    if R < 1 or M < 1:
+        raise ValueError(f"need rows >= 1 and max_segments >= 1, "
+                         f"got rows={R} max_segments={M}")
+    input_ids = np.full((R, S), pad_id, np.int32)
+    segment_ids = np.zeros((R, S), np.int32)
+    position_ids = np.zeros((R, S), np.int32)
+    cls_pos = np.zeros((R, M), np.int32)
+    used = [0] * R     # tokens occupied per row
+    segs = [0] * R     # segments opened per row
+    opened = 0         # rows touched so far (first-fit opens them in order)
+    placements: List[Optional[Tuple[int, int]]] = []
+    for ids in id_lists:
+        L = len(ids)
+        if L > S:
+            raise ValueError(f"list of {L} tokens exceeds the {S}-token "
+                             "pack width — truncate before packing")
+        if L == 0:
+            # an empty list would open a phantom segment whose
+            # cls_positions entry aliases the NEXT segment's offset — its
+            # caller would silently receive a neighbor's logits.  Callers
+            # (serve submit paths) reject empties before packing.
+            raise ValueError("empty id list cannot be packed — reject "
+                             "empty requests before batch formation")
+        row = next((r for r in range(opened)
+                    if segs[r] < M and used[r] + L <= S), None)
+        if row is None:
+            if opened >= R:
+                placements.append(None)  # full batch: ride the next one
+                continue
+            row = opened
+            opened += 1
+        off = used[row]
+        input_ids[row, off: off + L] = np.asarray(ids, np.int32)
+        segment_ids[row, off: off + L] = segs[row] + 1
+        # positions restart per segment — exact embedding parity with the
+        # request's own padded forward (the training packer's contract)
+        position_ids[row, off: off + L] = np.arange(L, dtype=np.int32)
+        cls_pos[row, segs[row]] = off
+        placements.append((row, segs[row]))
+        used[row] += L
+        segs[row] += 1
+    batch = {
+        "input_ids": input_ids,
+        "segment_ids": segment_ids,
+        "position_ids": position_ids,
+        "attention_mask": (segment_ids > 0).astype(np.int32),
+        "token_type_ids": np.zeros((R, S), np.int32),
+        "cls_positions": cls_pos,
+    }
+    return batch, placements
 
 
 def segment_bias(segment_ids: np.ndarray, dtype=np.float32) -> np.ndarray:

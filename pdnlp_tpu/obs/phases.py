@@ -58,8 +58,10 @@ _ADOPTION_ATTRS = ("attn_impl", "dtype")
 
 #: the serve-side span vocabulary: ``queue_wait`` (batcher/router pre-batch
 #: wait, ``retry`` attr counts re-dispatched requests), ``forward`` /
-#: ``compile`` (engine execution, cache hit vs first-seen shape), ``swap``
-#: (a rolling checkpoint hot-swap).  Spans carrying a ``replica`` attr feed
+#: ``compile`` (engine execution, cache hit vs first-seen shape; packed
+#: forwards additionally carry ``packed``/``fill``/``segments`` attrs —
+#: token-level fill and riding-request count per batch), ``swap`` (a
+#: rolling checkpoint hot-swap).  Spans carrying a ``replica`` attr feed
 #: the PER-REPLICA phase tables — one sick replica must show up as itself
 #: in ``trace_tpu.py summarize``, not as a pool-average smear.
 SERVE_PHASES = ("queue_wait", "forward", "compile", "swap")
@@ -135,6 +137,10 @@ class StepBreakdown:
         # ``replica`` attr) + retry counts from queue_wait records
         self._serve: Dict[object, Dict[str, List[float]]] = {}
         self._serve_retries: Dict[object, int] = {}
+        # per-replica token-level fill of executed forwards (the ``fill``
+        # attr engine spans carry) + how many of them were packed batches
+        self._serve_fill: Dict[object, List[float]] = {}
+        self._serve_packed: Dict[object, int] = {}
 
     # ------------------------------------------------------------- feeding
     def feed(self, record: Dict) -> None:
@@ -156,6 +162,17 @@ class StepBreakdown:
                     self._serve_retries[attrs["replica"]] = \
                         self._serve_retries.get(attrs["replica"], 0) \
                         + int(retry)
+                # fill aggregates FORWARD spans only: every compile span
+                # is a warmup dummy ([[CLS],[SEP]] at ~0.002 fill) and
+                # would drag a healthy replica's reported fill far below
+                # its steady state (the router snapshot's fill_ratio
+                # already excludes warmups — the two surfaces must agree)
+                if name == "forward" and attrs.get("fill") is not None:
+                    self._serve_fill.setdefault(
+                        attrs["replica"], []).append(float(attrs["fill"]))
+                    if attrs.get("packed"):
+                        self._serve_packed[attrs["replica"]] = \
+                            self._serve_packed.get(attrs["replica"], 0) + 1
         if name not in PHASES:
             return
         full = float(record.get("dur", 0.0))
@@ -257,6 +274,12 @@ class StepBreakdown:
             out["serve_by_replica"] = {
                 str(rep): {
                     "retries": self._serve_retries.get(rep, 0),
+                    # token-level fill of this replica's executed forwards
+                    # (None when its spans predate the fill attr)
+                    "fill_mean": (round(sum(self._serve_fill[rep])
+                                        / len(self._serve_fill[rep]), 4)
+                                  if self._serve_fill.get(rep) else None),
+                    "packed_batches": self._serve_packed.get(rep, 0),
                     "phases": {
                         phase: {
                             "count": len(vals),
@@ -323,7 +346,11 @@ def format_table(summary: Dict) -> str:
     # per-replica serve tables (router runs): one block per replica so a
     # slow or retry-heavy replica reads as ITSELF, not a pool average
     for rep, b in summary.get("serve_by_replica", {}).items():
-        lines.append(f"replica {rep}: {b['retries']} retried request(s)")
+        line = f"replica {rep}: {b['retries']} retried request(s)"
+        if b.get("fill_mean") is not None:
+            line += (f"  fill {b['fill_mean']:.2f}"
+                     f" ({b.get('packed_batches', 0)} packed batch(es))")
+        lines.append(line)
         for phase, s in b["phases"].items():
             lines.append(
                 f"  {phase:<12} {s['count']:>6d}x {s['total_sec']:>10.3f}s "

@@ -9,7 +9,9 @@ requests) — this package applies the same treatment to inference:
   ``(bucket_seq_len, batch_rows)`` so steady-state serving never retraces;
 - :mod:`pdnlp_tpu.serve.batcher` — bounded request queue with dynamic
   micro-batching (flush on size or ``max_wait_ms``), sequence-length
-  bucketing, backpressure and per-request deadlines;
+  bucketing, backpressure and per-request deadlines; ``serve_pack``
+  bin-packs requests many-per-row into fixed token-budget packed batches
+  (throughput scales with tokens, not requests);
 - :mod:`pdnlp_tpu.serve.router` — N engine replicas behind tiered admission
   (backpressure -> shed -> reject), least-loaded dispatch, heartbeat-based
   health ejection with requeue/retry, warmup-gated reintegration, and
@@ -24,7 +26,7 @@ Entry point: ``serve_tpu.py`` at the repo root.
 """
 from pdnlp_tpu.serve.batcher import (  # noqa: F401
     DEFAULT_BUCKETS, AdmissionControl, DeadlineExceeded, DynamicBatcher,
-    LoadShedError, QueueFullError, pick_bucket,
+    LoadShedError, QueueFullError, pick_bucket, resolve_serve_pack,
 )
 from pdnlp_tpu.serve.engine import InferenceEngine  # noqa: F401
 from pdnlp_tpu.serve.metrics import (  # noqa: F401
@@ -49,5 +51,6 @@ __all__ = [
     "RouterMetrics",
     "ServeMetrics",
     "pick_bucket",
+    "resolve_serve_pack",
     "score_texts",
 ]

@@ -22,7 +22,19 @@ batches.  The batcher bridges the two:
   expiry is checked when the flush timer is computed AND again at dequeue
   (a batch formed while the worker was busy must not carry corpses), and
   ``result()`` without an explicit timeout bounds its wait by the
-  request's own remaining deadline budget.
+  request's own remaining deadline budget;
+- **packing** (``--serve_pack``): instead of padding each request to its
+  bucket width, admitted requests bin-pack many-per-row into ONE fixed
+  ``[rows, pack_width]`` packed batch (``data.packing.pack_id_lists`` —
+  the training packer's segment channels, served online), so throughput
+  scales with TOKENS, not requests.  The flush trigger becomes a token
+  budget (``rows x width`` real tokens queued, or the age bound), the
+  queue bound becomes a token bound, and batch formation is deadline-
+  aware: requests pack in lowest-remaining-slack order, so the most
+  urgent close the earliest rows and anything that does not fit waits.
+  ``auto`` (default) packs only where the segment-native pallas kernel
+  routes; ``off`` keeps per-bucket padding (also the permanent path for
+  the router's hedged duplicates).
 
 One worker thread owns the engine (JAX dispatch is not thread-safe-by-
 contract here, and a single dispatcher keeps the device busy without lock
@@ -75,6 +87,29 @@ def pick_bucket(n_tokens: int, buckets: Sequence[int]) -> int:
         if n_tokens <= b:
             return b
     return max(buckets)
+
+
+def resolve_serve_pack(mode: str, pack_width: int) -> bool:
+    """ONE resolution of ``--serve_pack auto|on|off`` -> packed or padded,
+    shared by the batcher, the router and the CLI/bench so a request can
+    never be packed by one layer and padded by another.
+
+    ``auto`` packs exactly where the segment-native pallas flash kernel
+    routes for the pack width (TPU, 128-tiling widths): there the packed
+    batch pays block-diagonal attention in-kernel and the win is pure.
+    Elsewhere (CPU tests, non-tiling widths) the XLA fallback materializes
+    the ``[B,1,S,S]`` segment bias per batch — packing still usually wins
+    on padding waste (``on`` forces it; the bench gates it), but it is an
+    opt-in, not a default."""
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"serve_pack must be 'auto', 'on' or 'off', "
+                         f"got {mode!r}")
+    if mode != "auto":
+        return mode == "on"
+    from pdnlp_tpu.ops.attention import routed_impl_cached
+
+    return routed_impl_cached("auto", int(pack_width),
+                              segmented=True) == "pallas"
 
 
 #: grace added to a deadline-derived ``result()`` timeout: a request can be
@@ -144,6 +179,75 @@ class _Request:
             self._error = error
             self._event.set()
             return True
+
+
+def pack_order(requests: Sequence["_Request"], now: float,
+               age_floor_s: Optional[float] = None) -> List["_Request"]:
+    """Deadline-aware packing priority: lowest remaining slack first
+    (deadline-free requests last, FIFO among equals) — the most urgent
+    requests close the earliest rows of the packed batch, and whatever
+    does not fit is exactly the work that could best afford to wait.
+
+    ``age_floor_s`` (the flush policy's ``max_wait_ms``) is the
+    anti-starvation valve: a request whose queue wait has reached the
+    floor outranks ALL slack ordering (FIFO among the aged), so
+    deadline-free or far-deadline work cannot be displaced batch after
+    batch by a sustained stream of urgent arrivals — and the aged-flush
+    trigger (keyed on the oldest request) always serves the request that
+    fired it instead of re-firing forever."""
+    def key(r: "_Request"):
+        if age_floor_s is not None and now - r.submitted >= age_floor_s:
+            return (0, r.submitted, 0.0)
+        return (1, r.slack(now), r.submitted)
+
+    return sorted(requests, key=key)
+
+
+class _PackedBatch:
+    """One flushed packed batch: the fixed-shape channel arrays
+    (``data.packing.pack_id_lists``) plus each riding request's
+    ``(row, slot)`` placement — the scatter map that routes the
+    ``[rows, M, C]`` packed logits back to their callers."""
+
+    __slots__ = ("requests", "arrays", "placements", "tokens")
+
+    def __init__(self, requests: List["_Request"], arrays: Dict,
+                 placements: List, tokens: int):
+        self.requests = requests
+        self.arrays = arrays
+        self.placements = placements
+        self.tokens = int(tokens)      # real tokens riding the batch
+
+    @property
+    def slots(self) -> int:
+        """Token slots the forward pays for (rows x width)."""
+        return int(self.arrays["input_ids"].size)
+
+    @property
+    def fill(self) -> float:
+        return self.tokens / float(self.slots or 1)
+
+
+def form_packed_batch(requests: Sequence["_Request"], now: float,
+                      width: int, rows: int, max_segments: int,
+                      pad_id: int, age_floor_s: Optional[float]
+                      ) -> tuple:
+    """ONE copy of packed batch formation — ``pack_order`` priority ->
+    ``pack_id_lists`` -> (batch, leftovers) — shared by
+    :class:`DynamicBatcher` and the replica router so ordering, placement
+    and leftover semantics can never drift between the two serve paths.
+    Returns ``(packed_batch, leftover_requests)``; leftovers are the
+    requests that did not fit and must stay queued for the next batch."""
+    from pdnlp_tpu.data.packing import pack_id_lists
+
+    ordered = pack_order(requests, now, age_floor_s=age_floor_s)
+    arrays, placements = pack_id_lists(
+        [r.ids for r in ordered], width, rows, max_segments, pad_id=pad_id)
+    taken = [r for r, p in zip(ordered, placements) if p is not None]
+    placed = [p for p in placements if p is not None]
+    leftover = [r for r, p in zip(ordered, placements) if p is None]
+    tokens = sum(len(r.ids) for r in taken)
+    return _PackedBatch(taken, arrays, placed, tokens), leftover
 
 
 class AdmissionControl:
@@ -241,6 +345,8 @@ class DynamicBatcher:
         max_wait_ms: float = 5.0,
         max_queue: int = 256,
         default_deadline_ms: Optional[float] = None,
+        serve_pack: str = "auto",
+        pack_max_segments: int = 16,
     ):
         self.engine = engine
         self.buckets = usable_buckets(buckets, engine.args.max_seq_len)
@@ -252,9 +358,23 @@ class DynamicBatcher:
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue = int(max_queue)
         self.default_deadline_ms = default_deadline_ms
+        # packed online batching: requests bin-pack many-per-row into one
+        # fixed [rows, pack_width] batch; every bound moves to TOKEN units
+        # — the flush trigger is "a full batch worth of real tokens" and
+        # the queue bound is max_queue rows' worth of token slots, so a
+        # storm of short requests is admitted by the work it actually
+        # brings, not by how many envelopes it arrives in
+        self.packed = resolve_serve_pack(serve_pack, self.buckets[-1])
+        self.pack_width = self.buckets[-1]
+        self.pack_rows = self.max_batch_size
+        self.pack_segments = int(pack_max_segments)
+        self.flush_tokens = self.pack_rows * self.pack_width
+        self.max_queue_tokens = self.max_queue * self.pack_width
         self.metrics: ServeMetrics = engine.metrics
         self._queues: Dict[int, List[_Request]] = {b: [] for b in self.buckets}
+        self._pack_queue: List[_Request] = []
         self._pending = 0
+        self._pending_tokens = 0
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._stop = False
@@ -283,11 +403,15 @@ class DynamicBatcher:
         self._worker.join(timeout=10)
         self._worker = None
         with self._lock:  # fail anything still queued (stop(drain=False))
-            leftovers = [r for q in self._queues.values() for r in q]
+            leftovers = [r for q in self._queues.values() for r in q] \
+                + list(self._pack_queue)
             for q in self._queues.values():
                 q.clear()
+            self._pack_queue = []
             self._pending = 0
+            self._pending_tokens = 0
             self.metrics.queue_depth.set(0)
+            self.metrics.queue_tokens.set(0)
         for r in leftovers:
             r._complete(None, RuntimeError("batcher stopped"))
 
@@ -313,6 +437,11 @@ class DynamicBatcher:
 
     def submit_ids(self, ids: List[int],
                    deadline_ms: Optional[float] = None) -> _Request:
+        if not ids:
+            # an empty row is meaningless on the padded path and would
+            # corrupt a packed batch (phantom segment aliasing a
+            # neighbor's [CLS] gather) — reject at the door, loudly
+            raise ValueError("empty request: submit at least one token id")
         if len(ids) > self.buckets[-1]:
             # pre-encoded rows get a plain tail truncation (only submit()'s
             # text path knows the [CLS]/[SEP] framing to preserve) — a row
@@ -327,11 +456,24 @@ class DynamicBatcher:
         with self._lock:
             if self._stop or self._worker is None:
                 raise RuntimeError("batcher is not running (call start())")
-            if self._pending >= self.max_queue:
-                self.metrics.rejected_total.inc()
-                raise QueueFullError(
-                    f"queue full ({self._pending}/{self.max_queue})")
-            self._queues[req.bucket].append(req)
+            if self.packed:
+                # token-unit admission: capacity is max_queue rows' worth
+                # of token SLOTS — a short-request storm is bounded by the
+                # work it brings, not by its request count
+                if self._pending_tokens + len(ids) > self.max_queue_tokens:
+                    self.metrics.rejected_total.inc()
+                    raise QueueFullError(
+                        f"queue full ({self._pending_tokens}"
+                        f"/{self.max_queue_tokens} tokens)")
+                self._pack_queue.append(req)
+                self._pending_tokens += len(ids)
+                self.metrics.queue_tokens.set(self._pending_tokens)
+            else:
+                if self._pending >= self.max_queue:
+                    self.metrics.rejected_total.inc()
+                    raise QueueFullError(
+                        f"queue full ({self._pending}/{self.max_queue})")
+                self._queues[req.bucket].append(req)
             self._pending += 1
             self.metrics.requests_total.inc()
             self.metrics.queue_depth.set(self._pending)
@@ -339,13 +481,15 @@ class DynamicBatcher:
         return req
 
     # ------------------------------------------------------------- worker
-    def _take_flushable(self) -> Optional[List[_Request]]:
-        """Under the lock: pop a full bucket, an aged one, or None."""
+    def _take_flushable(self):
+        """Under the lock: pop a flushable batch or None — a full (or aged)
+        bucket on the padded path, a token-budget-full (or aged) packed
+        batch on the packed path."""
         now = time.monotonic()
         # expired-deadline requests leave their queue before batch selection
         # (their slot should not hold a flush back or ride a batch)
         expired: List[_Request] = []
-        for q in self._queues.values():
+        for q in list(self._queues.values()) + [self._pack_queue]:
             keep = []
             for r in q:
                 if r.deadline is not None and now >= r.deadline:
@@ -355,11 +499,25 @@ class DynamicBatcher:
             q[:] = keep
         if expired:
             self._pending -= len(expired)
+            if self.packed:  # tokens are only accounted on the packed path
+                self._pending_tokens -= sum(len(r.ids) for r in expired)
+                self.metrics.queue_tokens.set(self._pending_tokens)
             self.metrics.deadline_expired_total.inc(len(expired))
             self.metrics.queue_depth.set(self._pending)
             for r in expired:
                 r._complete(None, DeadlineExceeded(
                     "deadline passed while queued"))
+        if self.packed:
+            # token-budget flush: a full batch worth of REAL tokens queued
+            # (throughput), else the oldest request aged out (latency)
+            q = self._pack_queue
+            if not q:
+                return None
+            if self._pending_tokens >= self.flush_tokens \
+                    or (now - min(r.submitted for r in q)) * 1e3 \
+                    >= self.max_wait_ms:
+                return self._pack_pop(now)
+            return None
         # full bucket first (throughput); else the most-overdue aged bucket
         for b, q in self._queues.items():
             if len(q) >= self.max_batch_size:
@@ -370,6 +528,23 @@ class DynamicBatcher:
             if (now - oldest) * 1e3 >= self.max_wait_ms:
                 return self._pop(b, self.max_batch_size)
         return None
+
+    def _pack_pop(self, now: float) -> _PackedBatch:
+        """Under the lock: bin-pack the queue (``form_packed_batch``) into
+        one fixed-shape batch; whatever does not fit stays queued.
+        Holding the lock here is bounded work — the single-replica queue
+        is capped at ``max_queue_tokens`` and only submitters contend (the
+        router's multi-worker path packs OUTSIDE its pool-global lock)."""
+        pb, leftover = form_packed_batch(
+            self._pack_queue, now, self.pack_width, self.pack_rows,
+            self.pack_segments, self.engine.tokenizer.pad_id,
+            self.max_wait_ms / 1e3)
+        self._pack_queue = leftover
+        self._pending -= len(pb.requests)
+        self._pending_tokens -= pb.tokens
+        self.metrics.queue_depth.set(self._pending)
+        self.metrics.queue_tokens.set(self._pending_tokens)
+        return pb
 
     def _pop(self, bucket: int, n: int) -> List[_Request]:
         q = self._queues[bucket]
@@ -382,7 +557,7 @@ class DynamicBatcher:
         """Seconds until the earliest timeout/deadline, or None to sleep."""
         now = time.monotonic()
         ticks = []
-        for q in self._queues.values():
+        for q in list(self._queues.values()) + [self._pack_queue]:
             for r in q:
                 ticks.append(r.submitted + self.max_wait_ms / 1e3)
                 if r.deadline is not None:
@@ -404,7 +579,19 @@ class DynamicBatcher:
             with self._lock:
                 self._wake.notify_all()  # unblock stop(drain=True) waiters
 
-    def _execute(self, batch: List[_Request]) -> None:
+    def warmup(self) -> None:
+        """Pre-trace every shape live traffic can reach: the single fixed
+        packed shape on the packed path, one batch per bucket on the padded
+        path — after this, steady-state serving never compiles."""
+        if self.packed:
+            self.engine.warmup_packed(self.pack_width, self.pack_rows,
+                                      self.pack_segments)
+        else:
+            self.engine.warmup(self.buckets, self.max_batch_size)
+
+    def _execute(self, batch) -> None:
+        if isinstance(batch, _PackedBatch):
+            return self._execute_packed(batch)
         bucket = batch[0].bucket
         t0 = time.monotonic()
         # dequeue-time expiry: the flush decision and this execution are
@@ -447,4 +634,44 @@ class DynamicBatcher:
                 r._complete(logits[i])
         except BaseException as e:  # noqa: BLE001 — a failed batch must
             for r in batch:        # never leave callers blocked forever
+                r._complete(None, e)
+
+    def _execute_packed(self, pb: _PackedBatch) -> None:
+        t0 = time.monotonic()
+        # the batch is already packed — a corpse's tokens ride anyway —
+        # but its caller gave up, so complete it with the expiry error and
+        # skip its scatter rather than hand back a result nobody awaits
+        live: List[tuple] = []
+        for r, place in zip(pb.requests, pb.placements):
+            if r.deadline is not None and t0 >= r.deadline:
+                self.metrics.deadline_expired_total.inc()
+                r._complete(None, DeadlineExceeded(
+                    "deadline passed while queued"))
+            else:
+                live.append((r, place))
+        if not live:
+            return
+        for r, _ in live:
+            self.metrics.queue_wait_ms.observe((t0 - r.submitted) * 1e3)
+        tr = self.engine.tracer
+        if tr.enabled:
+            now = tr.now()
+            oldest = max(t0 - r.submitted for r, _ in live)
+            tr.record("queue_wait", now - oldest, now,
+                      bucket=self.pack_width, rows=len(live), packed=True)
+        try:
+            logits = self.engine.infer_packed(pb.arrays,
+                                              segments=len(live))
+            self.metrics.batches_total.inc()
+            # occupancy in TOKEN slots: a packed batch always spends every
+            # row, so rows would read 1.0 forever — real tokens over the
+            # rows x width slots is the number that stays honest
+            self.metrics.batch_occupancy.observe(pb.fill)
+            done = time.monotonic()
+            for r, (row, slot) in live:
+                self.metrics.request_latency_ms.observe(
+                    (done - r.submitted) * 1e3)
+                r._complete(logits[row, slot])
+        except BaseException as e:  # noqa: BLE001 — a failed batch must
+            for r, _ in live:      # never leave callers blocked forever
                 r._complete(None, e)

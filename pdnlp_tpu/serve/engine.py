@@ -219,6 +219,15 @@ class InferenceEngine:
             span_name = "compile"  # first call at this shape traces
         fwd = {k: batch[k] for k in ("input_ids", "attention_mask",
                                      "token_type_ids")}
+        # token-level occupancy: the padded path's honest waste number —
+        # real tokens over the rows x width slots this forward pays for.
+        # Compile (= warmup) batches are dummies at ~0.002 fill and are
+        # excluded — every fill surface (these histograms, the replica
+        # metrics, the phases fill column) must report steady state
+        fill = float(batch["attention_mask"].sum()) / float(rows * seq)
+        if span_name == "forward":
+            self.metrics.fill_ratio.observe(fill)
+            self.metrics.padding_waste.observe(1.0 - fill)
         if self.mesh is not None:
             from pdnlp_tpu.parallel.sharding import batch_sharding
 
@@ -230,8 +239,60 @@ class InferenceEngine:
         # dtype/attn_impl attrs make int8/pallas adoption visible in
         # trace_tpu.py summarize and the trace-diff gate.
         with self.tracer.span(span_name, seq=int(seq), rows=int(rows),
-                              dtype=self.dtype_label,
+                              dtype=self.dtype_label, fill=round(fill, 4),
                               attn_impl=self.routed_attn(int(seq)),
+                              **self.span_attrs):
+            logits = self._jit_forward(self.params, fwd)
+            out = np.asarray(jax.device_get(logits))
+        return out
+
+    #: the channels a packed serve batch carries into the jitted forward —
+    #: ``data.packing.pack_id_lists``'s layout, and exactly what
+    #: ``models.bert.classify`` keys its packed (per-segment) program on
+    PACKED_CHANNELS = ("input_ids", "attention_mask", "token_type_ids",
+                       "segment_ids", "position_ids", "cls_positions")
+
+    def infer_packed(self, batch: Dict[str, np.ndarray],
+                     segments: int = 0) -> np.ndarray:
+        """Packed batch (``data.packing.pack_id_lists``) -> host logits
+        ``[rows, max_segments, num_labels]`` (fp32) — one forward serving
+        many requests per row.
+
+        The compile-cache key is ``(seq, rows, "packed")``: every packed
+        batch the batcher emits has the SAME fixed shape (rows x the pack
+        width, segment capacity included), so the packed path holds exactly
+        one compiled program and is retrace-free by construction once
+        :meth:`warmup_packed` has traced it.  Spans carry ``packed``/
+        ``fill``/``segments`` attrs so per-replica fill is visible in
+        ``trace_tpu.py summarize``; ``segments`` is the number of real
+        requests riding the batch.
+        """
+        rows, seq = batch["input_ids"].shape
+        key = (int(seq), int(rows), "packed")
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+            span_name = "forward"
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+            span_name = "compile"
+        fill = float(batch["attention_mask"].sum()) / float(rows * seq)
+        if span_name == "forward":  # warmup dummies stay out of steady state
+            self.metrics.fill_ratio.observe(fill)
+            self.metrics.padding_waste.observe(1.0 - fill)
+        fwd = {k: batch[k] for k in self.PACKED_CHANNELS}
+        if self.mesh is not None:
+            from pdnlp_tpu.parallel.sharding import batch_sharding
+
+            sh = batch_sharding(self.mesh)
+            fwd = {k: jax.make_array_from_process_local_data(sh, v)
+                   for k, v in fwd.items()}
+        with self.tracer.span(span_name, seq=int(seq), rows=int(rows),
+                              packed=True, fill=round(fill, 4),
+                              segments=int(segments),
+                              dtype=self.dtype_label,
+                              attn_impl=self.routed_attn(int(seq),
+                                                         segmented=True),
                               **self.span_attrs):
             logits = self._jit_forward(self.params, fwd)
             out = np.asarray(jax.device_get(logits))
@@ -255,17 +316,21 @@ class InferenceEngine:
         logits = self.infer_ids(ids, seq_len)
         return np.argmax(logits, axis=-1), logits
 
-    def routed_attn(self, seq: int) -> str:
+    def routed_attn(self, seq: int, segmented: bool = False) -> str:
         """The attention impl a forward at this bucket width actually
         routes to (``ops.attention.routed_impl_cached``) — a requested
         pallas falls back to XLA below the 128-wide kernel blocks, so
         per-seq routing is what spans and per-bucket reporting must carry,
-        not the max-width :attr:`attn_impl`.  ``_impl_by_seq`` records the
-        widths THIS engine served (:attr:`attn_impl_by_seq`); the
-        memoization itself lives at the routing point."""
+        not the max-width :attr:`attn_impl`.  ``segmented=True`` is the
+        packed forward's route (block-diagonal mask from segment IDs —
+        the segment-native pallas kernel where it applies).
+        ``_impl_by_seq`` records the widths THIS engine served
+        (:attr:`attn_impl_by_seq`); the memoization itself lives at the
+        routing point."""
         from pdnlp_tpu.ops.attention import routed_impl_cached
 
-        impl = routed_impl_cached(self._attn_requested, seq)
+        impl = routed_impl_cached(self._attn_requested, seq,
+                                  segmented=segmented)
         self._impl_by_seq.setdefault(seq, impl)
         return impl
 
@@ -300,3 +365,15 @@ class InferenceEngine:
         for seq in buckets:
             self.infer_ids([[self.tokenizer.cls_id, self.tokenizer.sep_id]],
                            seq, rows)
+
+    def warmup_packed(self, seq_len: int, rows: int,
+                      max_segments: int) -> None:
+        """Pre-trace the ONE packed shape (``(seq_len, rows, "packed")``):
+        every packed batch the online path emits reuses this compiled
+        program, so after this call the packed path cannot retrace."""
+        from pdnlp_tpu.data.packing import pack_id_lists
+
+        batch, _ = pack_id_lists(
+            [[self.tokenizer.cls_id, self.tokenizer.sep_id]], seq_len,
+            self.pad_rows(rows), max_segments, pad_id=self.tokenizer.pad_id)
+        self.infer_packed(batch, segments=1)

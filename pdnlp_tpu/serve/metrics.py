@@ -12,8 +12,17 @@ What each instrument answers:
 - ``queue_wait_ms`` — how long requests sat before their batch flushed
   (separates batching delay from compute);
 - ``queue_depth`` — instantaneous queued-request gauge (backpressure health);
-- ``batch_occupancy`` — real rows / padded rows per executed batch (how much
-  accelerator work is filler; 1.0 = perfectly packed);
+- ``batch_occupancy`` — per executed batch, the fraction of paid-for
+  accelerator slots doing real work: real rows / padded rows on the padded
+  path, real TOKENS / (rows x width) token slots on the packed path (a
+  packed batch always uses every row, so row units would pin it at 1.0 —
+  token slots are the unit that stays honest across both paths);
+- ``fill_ratio`` / ``padding_waste`` — token-level accounting for every
+  executed batch on BOTH paths: real tokens / total token slots, and its
+  complement (the fraction of the forward burned on padding — the number
+  packed serving exists to crush);
+- ``queue_tokens`` — instantaneous queued REAL-token gauge (the packed
+  flush policy and token-unit admission operate in this unit);
 - ``cache_hits`` / ``cache_misses`` — engine compiled-shape cache: a miss is
   the first call at a ``(bucket, rows)`` shape, a hit is every later one;
 - ``retraces`` — times the jitted forward actually re-traced; after warmup
@@ -41,7 +50,10 @@ class ServeMetrics:
         self.request_latency_ms = Histogram()
         self.queue_wait_ms = Histogram()
         self.batch_occupancy = Histogram()
+        self.fill_ratio = Histogram()
+        self.padding_waste = Histogram()
         self.queue_depth = Gauge()
+        self.queue_tokens = Gauge()
         self.cache_hits = Counter()
         self.cache_misses = Counter()
         self.retraces = Counter()
@@ -58,9 +70,12 @@ class ServeMetrics:
             "deadline_expired_total": self.deadline_expired_total.value,
             "batches_total": self.batches_total.value,
             "queue_depth": self.queue_depth.value,
+            "queue_tokens": self.queue_tokens.value,
             "request_latency_ms": self.request_latency_ms.snapshot(),
             "queue_wait_ms": self.queue_wait_ms.snapshot(),
             "batch_occupancy": self.batch_occupancy.snapshot(),
+            "fill_ratio": self.fill_ratio.snapshot(),
+            "padding_waste": self.padding_waste.snapshot(),
             "compile_cache": {
                 "hits": self.cache_hits.value,
                 "misses": self.cache_misses.value,
@@ -87,7 +102,11 @@ class ReplicaMetrics:
     ITSELF, not as a pool-average smear:
 
     - ``queue_depth`` / ``inflight`` — where that replica's backlog stands;
-    - ``batch_occupancy`` — real rows / padded rows for batches IT executed;
+    - ``batch_occupancy`` — slot accounting for batches IT executed (real
+      rows / padded rows padded, real tokens / token slots packed — token
+      units, so a packed replica can never read >1.0 or permanently low);
+    - ``fill_ratio`` — token-level fill of its executed batches (both
+      paths: real tokens / rows x width);
     - ``batches_total`` / ``requests_total`` — dispatch volume;
     - ``requeued_out`` — requests moved OFF this replica at ejection (the
       "ejected without dropping its queued requests" receipt);
@@ -101,6 +120,7 @@ class ReplicaMetrics:
         self.queue_depth = Gauge()
         self.inflight = Gauge()
         self.batch_occupancy = Histogram()
+        self.fill_ratio = Histogram()
         self.batches_total = Counter()
         self.requests_total = Counter()
         self.requeued_out = Counter()
@@ -119,6 +139,7 @@ class ReplicaMetrics:
             "retries": self.retries.value,
             "ejections": self.ejections.value,
             "batch_occupancy": self.batch_occupancy.snapshot(),
+            "fill_ratio": self.fill_ratio.snapshot(),
         }
 
 

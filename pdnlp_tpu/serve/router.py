@@ -39,7 +39,15 @@ robustness discipline PR 7 built for training:
   the rest of the pool;
 - **optional tail hedging** — a request stuck in a queue past ``hedge_ms``
   with deadline budget left is duplicated onto a less-loaded replica;
-  first completion wins.
+  first completion wins;
+- **packed online batching** (``serve_pack``, default ``auto``) — each
+  replica bin-packs its queue many-requests-per-row into ONE fixed
+  ``[rows, pack_width]`` packed batch (``data.packing.pack_id_lists``,
+  lowest-deadline-slack rows close first), flush policy and admission move
+  to TOKEN units, and ejection re-packs the victim's queued + in-flight
+  requests on the survivors' token queues.  Hedged duplicates always stay
+  on the padded per-bucket path (both paths are warmed, so neither can
+  retrace post-warmup).
 
 Single-replica serving is untouched: :class:`DynamicBatcher` remains the
 default path (``serve_tpu.py`` only builds a router under ``--replicas N``
@@ -56,7 +64,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 from pdnlp_tpu.parallel.watchdog import GangMonitor, Heartbeat
 from pdnlp_tpu.serve.batcher import (
     DEFAULT_BUCKETS, AdmissionControl, DeadlineExceeded, LoadShedError,
-    QueueFullError, _Request, pick_bucket, usable_buckets,
+    QueueFullError, _PackedBatch, _Request, form_packed_batch, pick_bucket,
+    resolve_serve_pack, usable_buckets,
 )
 from pdnlp_tpu.serve.metrics import ReplicaMetrics, RouterMetrics
 from pdnlp_tpu.train.checkpoint import CorruptCheckpointError
@@ -82,7 +91,7 @@ class _Replica:
     one in the same slot)."""
 
     def __init__(self, index: int, engine, buckets: Sequence[int],
-                 flush_rows: int):
+                 flush_rows: int, pack_width: int = 0):
         self.index = index
         self.engine = engine
         self.state = "warming"
@@ -91,7 +100,13 @@ class _Replica:
         # multiple anyway, so flushing at a smaller size would cap this
         # replica's occupancy below 1.0 forever
         self.flush_rows = int(flush_rows)
+        # packed path: the flush trigger in TOKEN units — a full packed
+        # batch worth of real tokens (flush_rows rows x the pack width)
+        self.flush_tokens = self.flush_rows * int(pack_width)
         self.queues: Dict[int, List[_Request]] = {b: [] for b in buckets}
+        # packed mode's single token-level queue; the per-bucket queues
+        # stay alive beside it for hedged duplicates (padded by contract)
+        self.pack_queue: List[_Request] = []
         self.inflight: List[_Request] = []
         self.exit_code: Optional[int] = None  # None while the worker lives
         self.batches = 0
@@ -101,7 +116,16 @@ class _Replica:
         self.hb: Optional[Heartbeat] = None
 
     def queued(self) -> int:
-        return sum(len(q) for q in self.queues.values())
+        return sum(len(q) for q in self.queues.values()) \
+            + len(self.pack_queue)
+
+    def queued_tokens(self) -> int:
+        return sum(len(r.ids) for r in self.pack_queue)
+
+    def all_queues(self) -> List[List[_Request]]:
+        """Every queue holding requests (bucket queues + the pack queue)
+        — the sweep/shed/stop paths must see both."""
+        return list(self.queues.values()) + [self.pack_queue]
 
     def load(self) -> int:
         return self.queued() + len(self.inflight)
@@ -123,6 +147,23 @@ class _Slot:
         self.replica: Optional[_Replica] = None
         self.metrics = ReplicaMetrics()
         self.ejected_at: Optional[float] = None
+
+
+class _PackIntent:
+    """A flush decision for the packed path: a SNAPSHOT of the replica's
+    pack queue taken under the lock.  The expensive part — slack sort +
+    six channel-array builds (``form_packed_batch``) — then runs OUTSIDE
+    the pool-global lock (it would otherwise serialize every worker,
+    submitter and the monitor against one replica's batch formation).
+    The snapshot's requests stay IN the queue meanwhile, so ejection,
+    shedding and expiry keep their normal queued semantics; the worker
+    reconciles (removes the taken, abandons on ejection) under the lock
+    before executing."""
+
+    __slots__ = ("requests",)
+
+    def __init__(self, requests: List[_Request]):
+        self.requests = requests
 
 
 class _ReplicaProc:
@@ -177,6 +218,8 @@ class ReplicaRouter:
         shed_at: Optional[int] = None,
         backpressure_wait_ms: float = 50.0,
         shed_slack_ms: Optional[float] = None,
+        serve_pack: str = "auto",
+        pack_max_segments: int = 16,
         max_retries: int = 1,
         hedge_ms: Optional[float] = None,
         stall_timeout: float = 10.0,
@@ -196,11 +239,25 @@ class ReplicaRouter:
         self.max_batch_size = int(max_batch_size)
         self.max_wait_ms = float(max_wait_ms)
         self.default_deadline_ms = default_deadline_ms
+        # packed online serving: every admission/flush bound moves from
+        # request (row) units to TOKEN units — AdmissionControl itself is
+        # unit-agnostic (pending vs thresholds), so packed mode scales the
+        # thresholds by the pack width and walks the SAME ladder with
+        # pending-token depth.  Hedged duplicates always ride the padded
+        # per-bucket path (a hedge exists to dodge a slow replica, not to
+        # wait for a pack to fill).
+        self.packed = resolve_serve_pack(serve_pack, self.buckets[-1])
+        self.pack_width = self.buckets[-1]
+        self.pack_segments = int(pack_max_segments)
+        unit = self.pack_width if self.packed else 1
         # a request with less remaining slack than two flush waits cannot
         # make its deadline once the pool is in the shed band — that is the
         # default "doomed" floor the shed tier drops first
         self.admission = AdmissionControl(
-            max_queue, backpressure_at=backpressure_at, shed_at=shed_at,
+            max_queue * unit,
+            backpressure_at=(backpressure_at * unit
+                             if backpressure_at is not None else None),
+            shed_at=shed_at * unit if shed_at is not None else None,
             backpressure_wait_ms=backpressure_wait_ms,
             shed_slack_ms=(2 * max_wait_ms if shed_slack_ms is None
                            else shed_slack_ms),
@@ -222,6 +279,7 @@ class ReplicaRouter:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending = 0          # accepted, not yet completed
+        self._pending_tokens = 0   # same, in real tokens (packed admission)
         self._stop = False
         self._started = False
         self._monitor_thread: Optional[threading.Thread] = None
@@ -234,7 +292,8 @@ class ReplicaRouter:
     # ------------------------------------------------------------ lifecycle
     def _make_replica(self, index: int, engine) -> _Replica:
         rep = _Replica(index, engine, self.buckets,
-                       engine.pad_rows(self.max_batch_size))
+                       engine.pad_rows(self.max_batch_size),
+                       pack_width=self.pack_width)
         rep.hb = Heartbeat(self.hb_dir, index, interval=self._beat_interval,
                            clock=self.health_clock)
         # forward/compile spans carry the replica rank so the per-replica
@@ -299,7 +358,7 @@ class ReplicaRouter:
                 rep = slot.replica
                 if rep is None:
                     continue
-                for q in rep.queues.values():
+                for q in rep.all_queues():
                     leftovers += [r for r in q if not r.done()]
                     q.clear()
                 leftovers += [r for r in rep.inflight if not r.done()]
@@ -334,6 +393,7 @@ class ReplicaRouter:
         won = r._complete(logits, error)
         if won:
             self._pending -= 1
+            self._pending_tokens -= len(r.ids)
             self.metrics.queue_depth.set(self._pending)
             if error is None:
                 self.metrics.completed_total.inc()
@@ -364,6 +424,8 @@ class ReplicaRouter:
         take the request) or :class:`LoadShedError` (the shed tier dropped
         the arrival itself: its deadline slack was the pool's lowest and
         under the viability floor)."""
+        if not ids:
+            raise ValueError("empty request: submit at least one token id")
         if len(ids) > self.buckets[-1]:
             ids = list(ids)[: self.buckets[-1]]
         deadline_ms = deadline_ms if deadline_ms is not None \
@@ -385,16 +447,24 @@ class ReplicaRouter:
             self._enqueue(slot, req)
             self.metrics.requests_total.inc()
             self._pending += 1
+            self._pending_tokens += len(req.ids)
             self.metrics.queue_depth.set(self._pending)
             self._cond.notify_all()
         return req
+
+    @property
+    def _pending_units(self) -> int:
+        """Admission-ladder depth in the ladder's own unit: real TOKENS on
+        the packed path (thresholds were scaled by the pack width), raw
+        request count on the padded path."""
+        return self._pending_tokens if self.packed else self._pending
 
     def _admit(self, req: _Request) -> None:
         """Walk the admission ladder under the lock; raises to refuse."""
         adm = self.admission
         waited = False
         while True:
-            tier = adm.tier(self._pending)
+            tier = adm.tier(self._pending_units)
             if tier == "healthy":
                 return
             if tier == "backpressure":
@@ -418,13 +488,14 @@ class ReplicaRouter:
             # tier == "reject"
             self.metrics.rejected_total.inc()
             raise QueueFullError(
-                f"queue full ({self._pending}/{adm.max_queue})")
+                f"queue full ({self._pending_units}/{adm.max_queue}"
+                + (" tokens)" if self.packed else ")"))
 
     def _shed_pass(self, arriving: Optional[_Request] = None) -> None:
         """Shed-tier sweep (caller holds the lock): drop the doomed,
         lowest-slack first, across every replica queue."""
         queued = [r for s in self._slots if s.replica
-                  for q in s.replica.queues.values() for r in q
+                  for q in s.replica.all_queues() for r in q
                   if not r.done()]
         victims = self.admission.shed_victims(queued, arriving=arriving)
         if not victims:
@@ -433,7 +504,7 @@ class ReplicaRouter:
         for s in self._slots:
             if s.replica is None:
                 continue
-            for q in s.replica.queues.values():
+            for q in s.replica.all_queues():
                 q[:] = [r for r in q if id(r) not in victimset]
         for r in victims:
             if r is arriving:
@@ -461,7 +532,10 @@ class ReplicaRouter:
         return None
 
     def _enqueue(self, slot: _Slot, req: _Request) -> None:
-        slot.replica.queues[req.bucket].append(req)
+        if self.packed:
+            slot.replica.pack_queue.append(req)
+        else:
+            slot.replica.queues[req.bucket].append(req)
         slot.metrics.requests_total.inc()
         slot.metrics.queue_depth.set(slot.replica.queued())
 
@@ -492,10 +566,38 @@ class ReplicaRouter:
                             self._beat_interval,
                             timeout if timeout is not None else 3600.0))
                         continue
-                    rep.inflight = batch
                     slot = self._slots[rep.index]
+                    if not isinstance(batch, _PackIntent):
+                        # a _PackIntent's requests stay QUEUED (visible to
+                        # eject/shed/expiry) until the pack is formed below
+                        rep.inflight = batch
+                        slot.metrics.inflight.set(len(rep.inflight))
                     slot.metrics.queue_depth.set(rep.queued())
-                    slot.metrics.inflight.set(len(batch))
+                if isinstance(batch, _PackIntent):
+                    # the expensive bin-pack runs OUTSIDE the pool lock
+                    pb, _ = form_packed_batch(
+                        batch.requests, self.clock(), self.pack_width,
+                        rep.flush_rows, self.pack_segments,
+                        self._tokenizer.pad_id, self.max_wait_ms / 1e3)
+                    with self._lock:
+                        if self._stop or rep.state == "ejected":
+                            # ejected mid-pack: every snapshot request was
+                            # requeued onto survivors (they were still
+                            # queued) — abandon the formed batch
+                            continue
+                        # reconcile: take exactly the packed requests out
+                        # of the queue; anything the monitor completed
+                        # meanwhile (shed/expired) executes harmlessly —
+                        # its _finish is an idempotent no-op.  Leftovers
+                        # never left the queue, order intact.
+                        takenset = set(map(id, pb.requests))
+                        rep.pack_queue = [r for r in rep.pack_queue
+                                          if id(r) not in takenset]
+                        rep.inflight = pb.requests
+                        slot = self._slots[rep.index]
+                        slot.metrics.inflight.set(len(pb.requests))
+                        slot.metrics.queue_depth.set(rep.queued())
+                    batch = pb
                 self._execute(rep, batch)
                 with self._lock:
                     rep.inflight = []
@@ -527,6 +629,13 @@ class ReplicaRouter:
                 [[self._tokenizer.cls_id, self._tokenizer.sep_id]], seq,
                 rows=rep.flush_rows)
             rep.hb.beat(force=True)  # a slow compile must not read as a stall
+        if self.packed:
+            # the packed path's ONE compiled shape; the bucket warmups
+            # above stay — hedged duplicates ride the padded path and must
+            # not pay (or count) a compile either
+            rep.engine.warmup_packed(self.pack_width, rep.flush_rows,
+                                     self.pack_segments)
+            rep.hb.beat(force=True)
         rep.retrace_warm = rep.engine.metrics.retraces.value
         with self._lock:
             slot = self._slots[rep.index]
@@ -543,12 +652,13 @@ class ReplicaRouter:
                     self.metrics.reintegrations_total.inc()
             self._cond.notify_all()
 
-    def _take_flushable(self, rep: _Replica) -> Optional[List[_Request]]:
-        """Under the lock: expire/skip dead entries, then pop a full bucket
-        or the most-overdue aged one (the batcher's flush policy, per
-        replica)."""
+    def _take_flushable(self, rep: _Replica):
+        """Under the lock: expire/skip dead entries, then pop a flushable
+        batch — token-budget/aged from the pack queue on the packed path,
+        a full or most-overdue aged bucket otherwise (hedged duplicates
+        keep the bucket path alive even when packing is on)."""
         now = self.clock()
-        for q in rep.queues.values():
+        for q in rep.all_queues():
             keep = []
             for r in q:
                 if r.done():  # hedge copy whose original already finished
@@ -559,6 +669,19 @@ class ReplicaRouter:
                 else:
                     keep.append(r)
             q[:] = keep
+        if rep.pack_queue:
+            # O(queue) scans, deliberately: the queue is bounded by the
+            # token-unit admission ceiling (max_queue x width tokens pool-
+            # wide, ~1e3 entries/replica at short-request mixes), so the
+            # sum + min cost ~tens of µs per wake — noise against the
+            # multi-ms batch execution, and the expensive part (batch
+            # FORMATION) already runs outside this lock via _PackIntent
+            if rep.queued_tokens() >= rep.flush_tokens \
+                    or (now - min(r.submitted for r in rep.pack_queue)) \
+                    * 1e3 >= self.max_wait_ms:
+                # snapshot only — the worker forms the batch OUTSIDE the
+                # pool lock (see _PackIntent) and reconciles after
+                return _PackIntent(list(rep.pack_queue))
         for b, q in rep.queues.items():
             if len(q) >= rep.flush_rows:
                 return self._pop(rep, b)
@@ -577,7 +700,7 @@ class ReplicaRouter:
     def _next_wakeup(self, rep: _Replica) -> Optional[float]:
         now = self.clock()
         ticks = []
-        for q in rep.queues.values():
+        for q in rep.all_queues():
             for r in q:
                 ticks.append(r.submitted + self.max_wait_ms / 1e3)
                 if r.deadline is not None:
@@ -586,7 +709,7 @@ class ReplicaRouter:
             return None
         return max(0.0, min(ticks) - now)
 
-    def _execute(self, rep: _Replica, batch: List[_Request]) -> None:
+    def _execute(self, rep: _Replica, batch) -> None:
         """Run one batch on ``rep``'s engine (outside the lock).  Chaos
         hooks fire here; any engine exception condemns the replica (its
         worker dies with the verdict, the monitor handles recovery)."""
@@ -598,6 +721,8 @@ class ReplicaRouter:
             if rep.state == "ejected" or self._stop:
                 raise _InjectedFault(f"replica {rep.index} wedged (injected)")
             time.sleep(0.02)
+        if isinstance(batch, _PackedBatch):
+            return self._execute_packed(rep, batch)
         bucket = batch[0].bucket
         t0 = self.clock()
         retried = sum(1 for r in batch if r.retries)
@@ -615,8 +740,36 @@ class ReplicaRouter:
         slot = self._slots[rep.index]
         slot.metrics.batches_total.inc()
         slot.metrics.batch_occupancy.observe(len(batch) / rows)
+        slot.metrics.fill_ratio.observe(
+            sum(len(r.ids) for r in batch) / float(rows * bucket))
         for i, r in enumerate(batch):
             self._finish(r, logits=logits[i], latency=True)
+
+    def _execute_packed(self, rep: _Replica, pb: _PackedBatch) -> None:
+        """The packed twin of :meth:`_execute`: one fixed-shape packed
+        forward serving every riding request, results scattered back by
+        the batch's ``(row, slot)`` placements.  Occupancy/fill land in
+        TOKEN units — a packed batch spends all its rows by construction,
+        so rows would read 1.0 forever."""
+        t0 = self.clock()
+        retried = sum(1 for r in pb.requests if r.retries)
+        for r in pb.requests:
+            self.metrics.queue_wait_ms.observe((t0 - r.submitted) * 1e3)
+        tr = self.tracer
+        if tr.enabled:
+            now = tr.now()
+            oldest = max(t0 - r.submitted for r in pb.requests)
+            tr.record("queue_wait", now - oldest, now, replica=rep.index,
+                      bucket=self.pack_width, rows=len(pb.requests),
+                      retry=retried, packed=True)
+        logits = rep.engine.infer_packed(pb.arrays,
+                                         segments=len(pb.requests))
+        slot = self._slots[rep.index]
+        slot.metrics.batches_total.inc()
+        slot.metrics.batch_occupancy.observe(pb.fill)
+        slot.metrics.fill_ratio.observe(pb.fill)
+        for r, (row, seg) in zip(pb.requests, pb.placements):
+            self._finish(r, logits=logits[row, seg], latency=True)
 
     # ------------------------------------------------------------- monitor
     def _monitor(self) -> None:
@@ -656,7 +809,7 @@ class ReplicaRouter:
             rep = s.replica
             if rep is None:
                 continue
-            for q in rep.queues.values():
+            for q in rep.all_queues():
                 keep = []
                 for r in q:
                     if r.done():
@@ -671,13 +824,16 @@ class ReplicaRouter:
     def _hedge_scan(self) -> None:
         """Tail hedging, bounded by the deadline budget: a request queued
         past ``hedge_ms`` that still has slack gets ONE duplicate on a
-        strictly less-loaded healthy replica; first completion wins."""
+        strictly less-loaded healthy replica; first completion wins.  The
+        duplicate always rides the PADDED per-bucket path — a hedge exists
+        to dodge a slow replica NOW, so it must not sit waiting for a pack
+        to fill, and the padded bucket shapes are always warm."""
         now = self.clock()
         for s in self._slots:
             rep = s.replica
             if rep is None or rep.state == "ejected":
                 continue
-            for q in rep.queues.values():
+            for q in rep.all_queues():
                 for r in q:
                     if (r.hedged or r.done()
                             or (now - r.submitted) * 1e3 < self.hedge_ms
@@ -704,9 +860,9 @@ class ReplicaRouter:
             slot.ejected_at = self.clock()
             self.metrics.ejections_total.inc()
             slot.metrics.ejections.inc()
-            queued = [r for q in rep.queues.values() for r in q]
+            queued = [r for q in rep.all_queues() for r in q]
             inflight = list(rep.inflight)
-            for q in rep.queues.values():
+            for q in rep.all_queues():
                 q.clear()
             rep.inflight = []
             slot.metrics.queue_depth.set(0)
@@ -723,7 +879,7 @@ class ReplicaRouter:
                         s.replica is not None
                         and s.replica.state != "ejected"
                         and any(r in q
-                                for q in s.replica.queues.values())
+                                for q in s.replica.all_queues())
                         for s in self._slots if s.index != index):
                     continue
                 if r.deadline is not None and now >= r.deadline:
@@ -749,7 +905,13 @@ class ReplicaRouter:
                     self.metrics.requeued_total.inc()
                 slot.metrics.requeued_out.inc()
                 target.metrics.requeued_in.inc()
-                target.replica.queues[r.bucket].append(r)
+                if self.packed:
+                    # survivors RE-PACK the orphans: they join the
+                    # target's token queue and ride its next packed batch
+                    # within whatever deadline budget they have left
+                    target.replica.pack_queue.append(r)
+                else:
+                    target.replica.queues[r.bucket].append(r)
                 target.metrics.queue_depth.set(target.replica.queued())
             self._cond.notify_all()
 

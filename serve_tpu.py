@@ -33,12 +33,19 @@ dropped), and flush the metrics snapshot + trace span files before exit.
     python serve_tpu.py --checkpoint output/dp-cls.msgpack \
         --input texts.txt --output preds.tsv --metrics_path results/serve.json
 
+``--serve_pack auto|on|off`` picks packed online batching: admitted
+requests bin-pack many-per-row into fixed ``[rows, pack_width]`` batches
+(the training packer's segment channels served online), so throughput
+scales with tokens, not requests; flush policy and queue admission move to
+token units.  ``auto`` (default) packs where the segment-native pallas
+kernel routes (TPU); ``off`` keeps the per-bucket padded path.
+
 Serve-local flags (not ``Args`` fields): ``--checkpoint`` (default: newest
 under ``--output_dir``), ``--buckets 32,64,128``, ``--max_batch_size``,
 ``--max_wait_ms``, ``--max_queue``, ``--deadline_ms``, ``--replicas``,
-``--hedge_ms``, ``--replica_stall_s``, ``--input``, ``--output``,
-``--metrics_path``, ``--no_mesh``.  Everything else (model, dtype, vocab,
-output_dir, ...) is the standard ``Args`` CLI.
+``--hedge_ms``, ``--replica_stall_s``, ``--serve_pack``, ``--input``,
+``--output``, ``--metrics_path``, ``--no_mesh``.  Everything else (model,
+dtype, vocab, output_dir, ...) is the standard ``Args`` CLI.
 """
 from __future__ import annotations
 
@@ -90,7 +97,8 @@ def build_router(args: Args, replicas: int, *,
                  max_wait_ms: float = 5.0, max_queue: int = 256,
                  deadline_ms: Optional[float] = None,
                  hedge_ms: Optional[float] = None,
-                 stall_timeout: float = 10.0) -> ReplicaRouter:
+                 stall_timeout: float = 10.0,
+                 serve_pack: str = "auto") -> ReplicaRouter:
     """N replica engines behind the fault-tolerant router.
 
     Placement: when the host exposes at least ``replicas`` devices (and
@@ -139,6 +147,8 @@ def build_router(args: Args, replicas: int, *,
         max_batch_size=max_batch_size, max_wait_ms=max_wait_ms,
         max_queue=max_queue, default_deadline_ms=deadline_ms,
         hedge_ms=hedge_ms, stall_timeout=stall_timeout,
+        serve_pack=serve_pack,
+        pack_max_segments=getattr(args, "pack_max_segments", 16),
         checkpoint_path=checkpoint, tracer=engines[0].tracer)
 
 
@@ -168,6 +178,7 @@ def main(argv=None) -> None:
     argv, replicas = pop_cli_flag(argv, "--replicas", 1, int)
     argv, hedge_ms = pop_cli_flag(argv, "--hedge_ms", None, float)
     argv, stall_s = pop_cli_flag(argv, "--replica_stall_s", 10.0, float)
+    argv, serve_pack = pop_cli_flag(argv, "--serve_pack", "auto")
     argv, in_path = pop_cli_flag(argv, "--input")
     argv, out_path = pop_cli_flag(argv, "--output")
     argv, metrics_path = pop_cli_flag(argv, "--metrics_path")
@@ -188,7 +199,7 @@ def main(argv=None) -> None:
             args, replicas, checkpoint=checkpoint, use_mesh=not no_mesh,
             buckets=buckets, max_batch_size=max_batch, max_wait_ms=max_wait,
             max_queue=max_queue, deadline_ms=deadline, hedge_ms=hedge_ms,
-            stall_timeout=stall_s)
+            stall_timeout=stall_s, serve_pack=serve_pack)
         engine = router.engine(0)  # metrics/tracer anchor
     else:
         engine = build_engine(args, checkpoint=checkpoint,
@@ -246,10 +257,13 @@ def main(argv=None) -> None:
         frontend = DynamicBatcher(
             engine, buckets=buckets, max_batch_size=max_batch,
             max_wait_ms=max_wait, max_queue=max_queue,
-            default_deadline_ms=deadline).start()
-        # warmup over the batcher's OWN clamped bucket list: one
-        # definition of "usable" (batcher.usable_buckets), zero drift
-        engine.warmup(frontend.buckets, engine.pad_rows(max_batch))
+            default_deadline_ms=deadline, serve_pack=serve_pack,
+            pack_max_segments=getattr(args, "pack_max_segments", 16),
+        ).start()
+        # warmup over the batcher's OWN resolved shapes: one definition of
+        # "usable" buckets AND of the pack mode (batcher.resolve_serve_pack
+        # / usable_buckets), zero drift between warmup and live traffic
+        frontend.warmup()
     rank0_print("ready — one text per line on stdin "
                 "(EOF to exit)", file=sys.stderr)
 
@@ -263,9 +277,23 @@ def main(argv=None) -> None:
     # each flushing a PADDED batch (flush_rows, the mesh data-axis
     # multiple) need N x that depth in flight before size-triggered
     # batching can engage on any one of them; the single-replica
-    # batcher's max_batch_size is already padded in its __init__
-    window = 2 * (replicas * router.engine(0).pad_rows(max_batch)
-                  if router is not None else frontend.max_batch_size)
+    # batcher's max_batch_size is already padded in its __init__.  On the
+    # packed path the appetite is a TOKEN budget — rows x width real
+    # tokens, i.e. up to rows x max_segments short requests per flush —
+    # so the window scales to the segment capacity instead, CAPPED at
+    # max_queue requests: packed admission is max_queue x width token
+    # slots, and a window of W requests can pin up to W x width pending
+    # tokens when inputs run long — an uncapped window would walk every
+    # submission into the reject tier on a long-text workload the padded
+    # path serves fine
+    if router is not None:
+        rows = router.engine(0).pad_rows(max_batch)
+        per_replica = rows * (router.pack_segments if router.packed else 1)
+        window = min(2 * replicas * per_replica, max_queue)
+    else:
+        window = min(2 * frontend.max_batch_size
+                     * (frontend.pack_segments if frontend.packed else 1),
+                     max_queue)
     inflight: deque = deque()
 
     def emit(fut) -> None:

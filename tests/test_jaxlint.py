@@ -188,6 +188,40 @@ def test_r10_hint_names_the_tracer():
     assert "span" in f.hint and "pdnlp_tpu.obs" in f.hint
 
 
+def test_r11_unpacked_serve_forward_positive():
+    # bare dict literal (11), bare constant-tuple comprehension (21),
+    # segment_ids without cls_positions (30) — each in a scope that
+    # routes segmented=True
+    assert all_hits("r11_pos.py") == [("R11", 11), ("R11", 21),
+                                      ("R11", 30)]
+
+
+def test_r11_unpacked_serve_forward_negative():
+    assert hits("r11_neg.py", "R11") == []
+
+
+def test_r11_requires_serve_context(tmp_path):
+    """The packed-channel contract binds serve modules only — a train or
+    bench scope assembling a plain batch is not in scope."""
+    p = tmp_path / "plain.py"
+    p.write_text(
+        "from pdnlp_tpu.ops.attention import routed_impl_cached\n\n"
+        "def f(jit_forward, x, seq):\n"
+        "    impl = routed_impl_cached('auto', seq, segmented=True)\n"
+        "    batch = {'input_ids': x, 'attention_mask': x,\n"
+        "             'token_type_ids': x}\n"
+        "    return jit_forward(batch), impl\n")
+    assert [f for f in analyze_paths([str(p)], root=str(tmp_path))
+            if f.rule_id == "R11"] == []
+
+
+def test_r11_hint_names_the_packing_surface():
+    path = os.path.join(FIXTURES, "r11_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R11"][0]
+    assert "cls_positions" in f.hint and "pack_id_lists" in f.hint
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -197,9 +231,9 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    # the registry sorts by id STRING (R10 between R1 and R2)
-    assert list(all_rules()) == ["R1", "R10", "R2", "R3", "R4", "R5", "R6",
-                                 "R7", "R8", "R9"]
+    # the registry sorts by id STRING (R10/R11 between R1 and R2)
+    assert list(all_rules()) == ["R1", "R10", "R11", "R2", "R3", "R4", "R5",
+                                 "R6", "R7", "R8", "R9"]
 
 
 # -------------------------------------------------------------- suppressions

@@ -1,0 +1,352 @@
+"""Packed online serving (PR 9): token-level bin-packing of admitted
+requests into fixed ``[rows, 128]`` packed batches.
+
+Pins the tentpole contracts: per-request logit parity between the packed
+and padded serve paths (bitwise where the segment lands at offset 0, and a
+near-full 0.98-fill row stays argmax-exact within float tolerance),
+deadline-ordered row closing (lowest remaining slack packs first), token-
+unit admission (a short-request storm is bounded by the work it brings,
+not its envelope count), requeue/eject of a packed in-flight batch
+re-packing on survivors, hedged duplicates staying on the padded path,
+and ZERO post-warmup retraces through the single packed cache key.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pdnlp_tpu.data.packing import pack_id_lists  # noqa: E402
+from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab  # noqa: E402
+from pdnlp_tpu.obs.phases import StepBreakdown, format_table  # noqa: E402
+from pdnlp_tpu.serve import (  # noqa: E402
+    DynamicBatcher, InferenceEngine, QueueFullError, ReplicaRouter,
+)
+from pdnlp_tpu.serve.batcher import (  # noqa: E402
+    _Request, pack_order, resolve_serve_pack,
+)
+from pdnlp_tpu.utils.config import Args  # noqa: E402
+
+from tests.test_router import FakeEngine  # noqa: E402
+
+TEXTS = ["天地人你我", "好坏大小上下来去", "爱恨喜怒哀乐", "高兴悲伤",
+         "讨厌愤怒来去你我他", "大小上下"]
+S = 128
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return WordPieceTokenizer(build_vocab(TEXTS, size=128))
+
+
+@pytest.fixture(scope="module")
+def engine(tok):
+    return InferenceEngine(Args(model="bert-tiny"), tokenizer=tok,
+                           mesh=None)
+
+
+# ------------------------------------------------------------------ packer
+def test_resolve_serve_pack_modes():
+    assert resolve_serve_pack("on", S) is True
+    assert resolve_serve_pack("off", S) is False
+    # auto follows the segment-native kernel's routing (pallas on TPU
+    # only) — on this CPU image it must resolve to the padded path
+    import jax
+
+    expected = jax.default_backend() == "tpu"
+    assert resolve_serve_pack("auto", S) is expected
+    with pytest.raises(ValueError):
+        resolve_serve_pack("always", S)
+
+
+def test_pack_id_lists_layout_and_placements():
+    lists = [[2, 5, 3], [2, 6, 6, 3], [2, 7, 3]]
+    batch, places = pack_id_lists(lists, seq_len=16, rows=2,
+                                  max_segments=2, pad_id=0)
+    assert batch["input_ids"].shape == (2, 16)
+    assert batch["cls_positions"].shape == (2, 2)
+    # first-fit in order, 2-segment cap: row 0 takes lists 0+1, row 1
+    # opens for list 2
+    assert places == [(0, 0), (0, 1), (1, 0)]
+    ii, seg, pos = (batch["input_ids"], batch["segment_ids"],
+                    batch["position_ids"])
+    np.testing.assert_array_equal(ii[0, :3], lists[0])
+    np.testing.assert_array_equal(ii[0, 3:7], lists[1])
+    np.testing.assert_array_equal(seg[0, :7], [1, 1, 1, 2, 2, 2, 2])
+    # positions restart per segment (embedding parity with the padded
+    # forward) and the mask is exactly the nonzero-segment region
+    np.testing.assert_array_equal(pos[0, :7], [0, 1, 2, 0, 1, 2, 3])
+    np.testing.assert_array_equal(batch["attention_mask"],
+                                  (seg > 0).astype(np.int32))
+    np.testing.assert_array_equal(batch["cls_positions"][0], [0, 3])
+    # every channel the packed forward consumes is present
+    assert set(InferenceEngine.PACKED_CHANNELS) <= set(batch)
+
+
+def test_pack_id_lists_overflow_waits_and_gaps_fill():
+    # rows=1, cap 16: the 10-token list no longer fits after the first
+    # two (12 used of 16) and must wait (None) — but the 4-token list
+    # after it still fills the gap.  Leftovers ride the NEXT batch.
+    lists = [[1] * 6, [1] * 6, [1] * 10, [1] * 4]
+    batch, places = pack_id_lists(lists, seq_len=16, rows=1,
+                                  max_segments=8)
+    assert places == [(0, 0), (0, 1), None, (0, 2)]
+    assert batch["attention_mask"].sum() == 16  # perfectly full row
+
+
+def test_deadline_ordered_packing():
+    """The most urgent requests close the earliest rows: pack order is
+    lowest remaining slack first, and when capacity only covers some of
+    the queue, the taken set is exactly the most-urgent prefix."""
+    now = time.monotonic()
+    reqs = []
+    for i, slack_s in enumerate([5.0, 0.5, None, 2.0, 0.1]):
+        r = _Request([2] + [5] * 6 + [3], S,
+                     None if slack_s is None else now + slack_s)
+        r.submitted = now - i * 1e-3  # FIFO tiebreak must not mask slack
+        reqs.append(r)
+    ordered = pack_order(reqs, now)
+    assert [reqs.index(r) for r in ordered] == [4, 1, 3, 0, 2]
+    # one 16-token row fits two 8-token requests: the two lowest-slack ride
+    _, places = pack_id_lists([r.ids for r in ordered], seq_len=16,
+                              rows=1, max_segments=8)
+    taken = [reqs.index(r) for r, p in zip(ordered, places)
+             if p is not None]
+    assert taken == [4, 1]
+
+
+def test_pack_order_age_floor_prevents_starvation():
+    """A deadline-free request cannot be displaced batch after batch by a
+    stream of urgent arrivals: once its queue wait reaches the age floor
+    (the flush policy's max_wait), it outranks ALL slack ordering — so
+    the aged-flush trigger always serves the request that fired it."""
+    now = time.monotonic()
+    old_free = _Request([2] + [5] * 6 + [3], S, None)  # deadline-free
+    old_free.submitted = now - 1.0                     # aged past floor
+    urgent = []
+    for i in range(4):
+        r = _Request([2] + [5] * 6 + [3], S, now + 0.01)  # 10ms slack
+        r.submitted = now
+        urgent.append(r)
+    # without the floor the deadline-free request sorts dead last...
+    assert pack_order([old_free] + urgent, now)[-1] is old_free
+    # ...with it, age wins: it heads the order and rides a one-row batch
+    ordered = pack_order([old_free] + urgent, now, age_floor_s=0.5)
+    assert ordered[0] is old_free
+    _, places = pack_id_lists([r.ids for r in ordered], seq_len=16,
+                              rows=1, max_segments=8)
+    assert places[0] is not None
+
+
+def test_empty_request_rejected_at_the_door():
+    """An empty id list would open a phantom segment aliasing a
+    neighbor's [CLS] gather — both submit paths and the packer refuse."""
+    with pytest.raises(ValueError, match="empty"):
+        pack_id_lists([[2, 3], []], seq_len=16, rows=2, max_segments=4)
+    eng = FakePackEngine()
+    b = DynamicBatcher(eng, buckets=(S,), serve_pack="on").start()
+    try:
+        with pytest.raises(ValueError, match="empty request"):
+            b.submit_ids([])
+    finally:
+        b.stop(drain=False)
+    r = ReplicaRouter([FakePackEngine()], buckets=(S,), serve_pack="on")
+    r.start()
+    assert r.wait_ready(10)
+    try:
+        with pytest.raises(ValueError, match="empty request"):
+            r.submit_ids([])
+    finally:
+        r.stop(drain=False)
+
+
+# ------------------------------------------------------------------ parity
+def test_packed_vs_padded_logits_parity(engine, tok):
+    ids = [tok.encode_ids(t, S) for t in TEXTS]
+    ref = engine.infer_ids(ids, S, rows=8)  # padded: one request per row
+    batch, places = pack_id_lists(ids, S, 8, 16, pad_id=tok.pad_id)
+    out = engine.infer_packed(batch, segments=len(ids))
+    assert out.shape[0] == 8 and out.shape[2] == engine.cfg.num_labels
+    for i, (row, slot) in enumerate(places):
+        assert np.argmax(out[row, slot]) == np.argmax(ref[i])
+        np.testing.assert_allclose(out[row, slot], ref[i],
+                                   rtol=1e-5, atol=1e-6)
+    # a row with a SINGLE segment is the padded forward's exact twin —
+    # same token/mask/position layout, so the logits are BITWISE equal
+    b1, p1 = pack_id_lists([ids[0]], S, 8, 16, pad_id=tok.pad_id)
+    o1 = engine.infer_packed(b1, segments=1)
+    np.testing.assert_array_equal(o1[p1[0][0], p1[0][1]], ref[0])
+
+
+def test_packed_parity_holds_at_098_fill(engine, tok):
+    # craft segments that fill a row to 126/128 tokens (0.984): offset
+    # segments reduce over shifted key indices, so the bound is float
+    # tolerance + exact argmax, not bitwise (the offset-0 case above is)
+    lens = [40, 40, 30, 16]
+    lists = [[tok.cls_id] + [5 + (i % 3)] * (L - 2) + [tok.sep_id]
+             for i, L in enumerate(lens)]
+    batch, places = pack_id_lists(lists, S, 1, 8, pad_id=tok.pad_id)
+    fill = batch["attention_mask"].sum() / float(S)
+    assert fill >= 0.98
+    out = engine.infer_packed(batch, segments=len(lists))
+    ref = engine.infer_ids(lists, S)
+    for i, (row, slot) in enumerate(places):
+        assert np.argmax(out[row, slot]) == np.argmax(ref[i])
+        np.testing.assert_allclose(out[row, slot], ref[i],
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ----------------------------------------------------------------- batcher
+def test_packed_batcher_end_to_end_zero_retraces(engine, tok):
+    with DynamicBatcher(engine, buckets=(32, 64, S), max_batch_size=4,
+                        max_wait_ms=5, serve_pack="on") as b:
+        assert b.packed and b.flush_tokens == b.pack_rows * S
+        b.warmup()
+        warm = engine.metrics.retraces.value
+        futs = [b.submit(TEXTS[i % len(TEXTS)]) for i in range(48)]
+        outs = [f.result(timeout=60) for f in futs]
+    assert engine.metrics.retraces.value - warm == 0, \
+        "the packed path must hold ONE compiled shape after warmup"
+    ref = engine.infer_ids([tok.encode_ids(t, S) for t in TEXTS], S)
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o, ref[i % len(TEXTS)],
+                                   rtol=1e-5, atol=1e-6)
+    # token-slot occupancy can never exceed 1.0 (the row-unit bug shape)
+    snap = engine.metrics.snapshot()
+    assert snap["batch_occupancy"]["max"] <= 1.0
+    assert snap["fill_ratio"]["count"] >= 1
+
+
+def test_token_unit_admission():
+    """Packed admission counts TOKENS: a max_queue of 2 rows' worth of
+    slots admits far more than 2 short requests, and rejects on the token
+    bound, not the request count."""
+    eng = FakePackEngine()
+    b = DynamicBatcher(eng, buckets=(S,), max_batch_size=64,
+                       max_wait_ms=60_000, max_queue=2, serve_pack="on")
+    b.start()
+    try:
+        assert b.max_queue_tokens == 2 * S
+        accepted = 0
+        with pytest.raises(QueueFullError):
+            for _ in range(1000):
+                b.submit_ids([2, 5, 5, 5, 5, 5, 5, 3])  # 8 tokens
+                accepted += 1
+        assert accepted == (2 * S) // 8  # 32 >> the 2-request row bound
+    finally:
+        b.stop(drain=False)
+
+
+# ------------------------------------------------------------------ router
+class FakePackEngine(FakeEngine):
+    """FakeEngine + the packed surface the router's warm/dispatch needs."""
+
+    def warmup_packed(self, seq_len, rows, max_segments):
+        self.calls.append(("warm_packed", int(seq_len), int(rows)))
+
+    def infer_packed(self, arrays, segments=0):
+        rows, seq = arrays["input_ids"].shape
+        M = arrays["cls_positions"].shape[1]
+        if self.latency:
+            time.sleep(self.latency)
+        self.calls.append(("packed", int(segments), int(seq)))
+        return np.full((rows, M, self.num_labels), float(seq), np.float32)
+
+
+def _pack_router(n=2, **kw):
+    engines = [FakePackEngine() for _ in range(n)]
+    kw.setdefault("buckets", (32, 64, S))
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_wait_ms", 2.0)
+    kw.setdefault("stall_timeout", 1.0)
+    kw.setdefault("poll_interval", 0.02)
+    kw.setdefault("serve_pack", "on")
+    r = ReplicaRouter(engines, **kw)
+    r.start()
+    assert r.wait_ready(10)
+    return r, engines
+
+
+def test_router_packed_eject_repacks_on_survivors():
+    # the 1s age trigger outlives the kill->eject->requeue hop (~the
+    # monitor's poll tick) by a wide margin, then flushes the survivors
+    r, engines = _pack_router(n=2, max_wait_ms=1000.0)
+    try:
+        with r._lock:  # strand queued work on replica 1, below the token
+            # flush budget so it sits in the pack queue when the kill lands
+            reqs = [_Request([2, 5, 5, 3], S, r.clock() + 30.0)
+                    for _ in range(6)]
+            for q in reqs:
+                r._slots[1].replica.pack_queue.append(q)
+                r._pending += 1
+                r._pending_tokens += len(q.ids)
+        r.kill_replica(1, "crash")
+        outs = [q.result(timeout=10) for q in reqs]
+        assert all(o.shape == (6,) for o in outs)
+        # the survivors served them PACKED (re-packed, not padded)
+        assert any(c[0] == "packed" for c in engines[0].calls)
+        snap = r.snapshot()
+        assert snap["router"]["ejections_total"] == 1
+        assert snap["replicas"]["0"]["requeued_in"] == 6
+        assert snap["replicas"]["0"]["fill_ratio"]["count"] >= 1
+    finally:
+        r.stop(drain=False)
+
+
+def test_hedged_copy_stays_on_padded_path():
+    # size bound unreachable (100-row flush): hedge copies must stay
+    # visibly QUEUED on the padded path for the assertions below
+    r, engines = _pack_router(n=2, max_batch_size=100,
+                              max_wait_ms=60_000.0, hedge_ms=30.0,
+                              poll_interval=0.01)
+    try:
+        with r._lock:  # park replica 1 behind a fake backlog so replica
+            # 0 is strictly less loaded when the hedge scan runs
+            blockers = [_Request([2, 3], S, None) for _ in range(3)]
+            for q in blockers:
+                r._slots[1].replica.pack_queue.append(q)
+                r._pending += 1
+            req = _Request([2, 5, 3], S, r.clock() + 30.0)
+            r._slots[1].replica.pack_queue.append(req)
+            r._pending += 1
+        deadline = time.monotonic() + 5
+        while not r.metrics.hedges_total.value \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert r.metrics.hedges_total.value >= 1
+        assert req.hedged
+        # the duplicate landed in the survivor's BUCKET queue — hedges
+        # ride the (always-warm) padded path, never wait for a pack
+        assert req in r._slots[0].replica.queues[S]
+        assert req not in r._slots[0].replica.pack_queue
+        # and the padded bucket shape was warmed on every replica even in
+        # packed mode, so the hedge cannot pay (or count) a compile
+        assert any(c == (1, S) for c in engines[0].calls)
+    finally:
+        r.stop(drain=False)
+
+
+# --------------------------------------------------------------------- obs
+def test_forward_span_fill_feeds_phase_tables():
+    bd = StepBreakdown()
+    for fill in (0.9, 0.8):
+        bd.feed({"name": "forward", "dur": 0.01, "t0": 0.0,
+                 "attrs": {"replica": 0, "fill": fill, "packed": True,
+                           "segments": 12, "dtype": "float32"}})
+    bd.feed({"name": "forward", "dur": 0.01, "t0": 0.0,
+             "attrs": {"replica": 1, "dtype": "float32"}})  # pre-fill span
+    # compile spans are warmup dummies (~0.002 fill) — they must NOT drag
+    # the steady-state fill column down
+    bd.feed({"name": "compile", "dur": 0.5, "t0": 0.0,
+             "attrs": {"replica": 0, "fill": 0.002, "packed": True}})
+    s = bd.summary()
+    rep0 = s["serve_by_replica"]["0"]
+    assert rep0["fill_mean"] == pytest.approx(0.85)
+    assert rep0["packed_batches"] == 2
+    assert s["serve_by_replica"]["1"]["fill_mean"] is None
+    table = format_table(s)
+    assert "fill 0.85" in table and "2 packed batch(es)" in table
