@@ -1,0 +1,156 @@
+"""R12 — traced/device values in span/record attributes.
+
+The obs tracer's design (PR 4) keeps instrumentation off the device
+stream: spans measure host windows, and device time surfaces ONLY through
+``Tracer.block``'s separate ``device_block`` span.  Passing a
+statically-device value (the result of a jitted dispatch) as a span/record
+ATTRIBUTE breaks that contract from the side door:
+
+- ``tracer.span("log", loss=metrics["loss"])`` stores a live device array
+  in the ring — serialization (flush/listeners) forces the host sync at an
+  arbitrary later point inside someone else's measured window, and the
+  ring pins device buffers alive;
+- ``tracer.span("log", loss=float(metrics["loss"]))`` syncs RIGHT THERE,
+  at the instrumentation site in the hot loop — the exact smearing the
+  dispatch/``device_block`` split exists to avoid.
+
+The sanctioned shape: materialize at the loop's own barrier (after
+``Tracer.block`` / ``jax.device_get``) and pass the already-host value —
+which is why propagation LAUNDERS through explicit sync calls: a variable
+assigned from ``float(jax.device_get(x))`` is host data, and attaching it
+to a later span is exactly right.
+
+Heuristic, per scope: values assigned from *dispatch-shaped* calls (names
+containing ``jit``/``forward``, or ``*step`` per the repo's jitted-step
+convention — tuple targets included) are device values; so is anything
+assigned from an expression that mentions one dynamically (static reads —
+``.shape``, ``len()`` — do not propagate, and an explicit sync call at the
+top of the RHS launders).  Keyword attributes of ``<x>.span(...)`` /
+``<x>.record(...)`` calls whose expression mentions a device value are
+flagged.  Only modules that import jax are in scope.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from pdnlp_tpu.analysis.core import (
+    STEP_CALL_RE, Finding, ModuleInfo, Rule, dotted_name, register,
+)
+
+#: calls whose RESULT is host data even when fed a device value — the
+#: laundering set for taint propagation (the sync happened there, at the
+#: caller's chosen point, not inside the tracer)
+_SYNC_CALLS = {"float", "int", "bool", "jax.device_get",
+               "numpy.asarray", "numpy.array"}
+_SYNC_METHODS = {"item", "tolist"}
+
+
+def _dispatch_shaped(name: str) -> bool:
+    last = name.split(".")[-1]
+    low = last.lower()
+    return "jit" in low or "forward" in low \
+        or bool(STEP_CALL_RE.fullmatch(last))
+
+
+@register
+class DeviceValueInSpanAttr(Rule):
+    rule_id = "R12"
+    name = "device-value-in-span-attr"
+    hint = ("span/record attrs must be host values: materialize at the "
+            "loop's barrier first (x = float(jax.device_get(v)) after "
+            "Tracer.block / device_get) and pass THAT — a traced/device "
+            "value in the attr forces a host sync inside the instrumented "
+            "region (or pins device buffers in the trace ring), smearing "
+            "device time the dispatch/device_block split exists to "
+            "separate (pdnlp_tpu.obs.trace)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if "jax" not in mod.aliases and not any(
+                a.startswith("jax") for a in mod.aliases.values()):
+            return  # pure-host module: nothing here is a device value
+        for _, scope_node, body in mod.scopes():
+            yield from self._check_scope(mod, scope_node, body)
+
+    # ----------------------------------------------------------- taint set
+    def _device_vars(self, mod: ModuleInfo, own: List[ast.AST]) -> Set[str]:
+        device: Set[str] = set()
+
+        def targets_of(node) -> Iterator[str]:
+            if isinstance(node, ast.Name):
+                yield node.id
+            elif isinstance(node, (ast.Tuple, ast.List)):
+                for elt in node.elts:
+                    yield from targets_of(elt)
+            elif isinstance(node, ast.Starred):
+                yield from targets_of(node.value)
+
+        def is_dispatch(value: ast.AST) -> bool:
+            if not isinstance(value, ast.Call):
+                return False
+            name = dotted_name(value.func)
+            return bool(name) and _dispatch_shaped(name)
+
+        def laundered(value: ast.AST) -> bool:
+            """RHS whose top-level call is an explicit sync: result is
+            host data, tracedness stops here."""
+            if not isinstance(value, ast.Call):
+                return False
+            if mod.resolves_to(value.func, _SYNC_CALLS):
+                return True
+            return isinstance(value.func, ast.Attribute) \
+                and value.func.attr in _SYNC_METHODS
+
+        grew = True
+        while grew:
+            grew = False
+            for node in own:
+                if isinstance(node, ast.Assign):
+                    pairs = [(t, node.value) for t in node.targets]
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                                       ast.NamedExpr)) and \
+                        getattr(node, "value", None) is not None:
+                    pairs = [(node.target, node.value)]
+                else:
+                    continue
+                for target, value in pairs:
+                    hot = is_dispatch(value) or (
+                        not laundered(value)
+                        and mod.mentions_traced(value, device))
+                    if not hot:
+                        continue
+                    for name in targets_of(target):
+                        if name not in device:
+                            device.add(name)
+                            grew = True
+        return device
+
+    # ------------------------------------------------------------ checking
+    def _check_scope(self, mod: ModuleInfo, scope_node, body
+                     ) -> Iterator[Finding]:
+        own = [n for stmt in body for n in ast.walk(stmt)
+               if self._in_scope(mod, scope_node, n)]
+        device = self._device_vars(mod, own)
+        if not device:
+            return
+        for node in own:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("span", "record")):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None or kw.value is None:
+                    continue
+                if mod.mentions_traced(kw.value, device):
+                    yield self.finding(
+                        mod, kw.value,
+                        f"span/record attr {kw.arg!r} is a traced/device "
+                        "value — forces a host sync inside the "
+                        "instrumented region (or pins device buffers in "
+                        "the trace ring)")
+
+    def _in_scope(self, mod: ModuleInfo, scope_node, node) -> bool:
+        fn = mod.enclosing_function(node)
+        if isinstance(scope_node, ast.Module):
+            return fn is None
+        return fn is scope_node or node is scope_node

@@ -141,11 +141,27 @@ class StepBreakdown:
         # attr engine spans carry) + how many of them were packed batches
         self._serve_fill: Dict[object, List[float]] = {}
         self._serve_packed: Dict[object, int] = {}
+        # device-memory accounting: "hbm" records (obs.memory samplers) and
+        # per-forward ``hbm_peak`` span attrs feed the memory columns — the
+        # peak is the HBM-budget number, last is the live occupancy
+        self._hbm_peak = 0
+        self._hbm_last = 0
+        self._serve_hbm: Dict[object, int] = {}   # replica -> peak bytes
+        # per-rank sub-summaries of a merged multi-process trace
+        # (from_records splits by pid so rank A's device_block can never
+        # close a step holding rank B's phases)
+        self._by_rank: Dict[int, Dict] = {}
 
     # ------------------------------------------------------------- feeding
     def feed(self, record: Dict) -> None:
         name = record.get("name")
         attrs = record.get("attrs") or {}
+        if name == "hbm":  # memory sample (obs.memory.MemorySampler)
+            with self._lock:
+                self._hbm_last = int(attrs.get("bytes_in_use", 0))
+                self._hbm_peak = max(self._hbm_peak,
+                                     int(attrs.get("peak_bytes", 0)))
+            return
         for key in _ADOPTION_ATTRS:
             v = attrs.get(key)
             if v is not None:
@@ -173,6 +189,12 @@ class StepBreakdown:
                     if attrs.get("packed"):
                         self._serve_packed[attrs["replica"]] = \
                             self._serve_packed.get(attrs["replica"], 0) + 1
+                if attrs.get("hbm_peak") is not None:
+                    # peak HBM per replica: the engine samples its mesh
+                    # slice's allocator before each executed batch
+                    self._serve_hbm[attrs["replica"]] = max(
+                        self._serve_hbm.get(attrs["replica"], 0),
+                        int(attrs["hbm_peak"]))
         if name not in PHASES:
             return
         full = float(record.get("dur", 0.0))
@@ -251,7 +273,14 @@ class StepBreakdown:
     # ------------------------------------------------------------- summary
     def summary(self) -> Dict:
         """JSON-ready per-phase stats: seconds mean/p50/p95/total/count,
-        plus share of the traced wall time."""
+        plus share of the traced wall time.  Takes the feed lock: the
+        live exporter snapshots a RUNNING breakdown from its own thread,
+        and iterating ``_per_phase`` while a first-seen phase key lands
+        would raise mid-scrape."""
+        with self._lock:
+            return self._summary_locked()
+
+    def _summary_locked(self) -> Dict:
         phases = {}
         grand = sum(sum(v) for v in self._per_phase.values()) or 1.0
         for phase, vals in sorted(self._per_phase.items(),
@@ -280,6 +309,11 @@ class StepBreakdown:
                                         / len(self._serve_fill[rep]), 4)
                                   if self._serve_fill.get(rep) else None),
                     "packed_batches": self._serve_packed.get(rep, 0),
+                    # peak HBM of this replica's device slice (None on
+                    # backends without memory_stats, e.g. CPU)
+                    "hbm_peak_gb": (round(
+                        self._serve_hbm[rep] / 2**30, 3)
+                        if rep in self._serve_hbm else None),
                     "phases": {
                         phase: {
                             "count": len(vals),
@@ -312,17 +346,81 @@ class StepBreakdown:
                 for bucket, b in sorted(self._per_bucket.items(),
                                         key=lambda kv: _bucket_key(kv[0]))
             }
+        if self._hbm_peak:
+            out["memory"] = {
+                "peak_bytes": self._hbm_peak,
+                "bytes_in_use": self._hbm_last,
+                "gb_peak": round(self._hbm_peak / 2**30, 3),
+            }
+        if self._by_rank:
+            out["by_rank"] = {str(rank): s for rank, s
+                              in sorted(self._by_rank.items())}
         return out
 
     @staticmethod
     def from_records(records: Sequence[Dict]) -> "StepBreakdown":
         """Rebuild a breakdown from an exported span stream (the CLI's
-        ``summarize``/``diff`` path)."""
-        bd = StepBreakdown()
+        ``summarize``/``diff`` path).
+
+        A MERGED multi-rank trace (``trace_tpu.py merge``) interleaves
+        processes; folding it through one accumulator would let rank A's
+        ``device_block`` close a step holding rank B's phases.  Records
+        are therefore split by ``pid`` and folded per rank; the returned
+        breakdown aggregates the per-rank observations (every step of
+        every rank is one observation) and keeps each rank's own summary
+        under ``summary()["by_rank"]``."""
+        by_pid: Dict[int, List[Dict]] = {}
         for rec in records:
-            bd.feed(rec)
-        bd.close()
-        return bd
+            by_pid.setdefault(int(rec.get("pid", 0)), []).append(rec)
+        if len(by_pid) <= 1:
+            bd = StepBreakdown()
+            for rec in records:
+                bd.feed(rec)
+            bd.close()
+            return bd
+        merged = StepBreakdown()
+        for pid in sorted(by_pid):
+            merged._absorb(StepBreakdown.from_records(by_pid[pid]), pid)
+        return merged
+
+    def _absorb(self, other: "StepBreakdown", rank: int) -> None:
+        """Fold one rank's closed breakdown into this multi-rank one."""
+        with self._lock:
+            self.steps += other.steps
+            self.groups += other.groups
+            self._count += other._count
+            for phase, vals in other._per_phase.items():
+                self._per_phase.setdefault(phase, []).extend(vals)
+            for key, by in other._impls.items():
+                mine = self._impls.setdefault(key, {})
+                for val, n in by.items():
+                    mine[val] = mine.get(val, 0) + n
+            for rep, per in other._serve.items():
+                mine = self._serve.setdefault(rep, {})
+                for phase, vals in per.items():
+                    mine.setdefault(phase, []).extend(vals)
+            for rep, n in other._serve_retries.items():
+                self._serve_retries[rep] = \
+                    self._serve_retries.get(rep, 0) + n
+            for rep, vals in other._serve_fill.items():
+                self._serve_fill.setdefault(rep, []).extend(vals)
+            for rep, n in other._serve_packed.items():
+                self._serve_packed[rep] = \
+                    self._serve_packed.get(rep, 0) + n
+            for rep, peak in other._serve_hbm.items():
+                self._serve_hbm[rep] = max(
+                    self._serve_hbm.get(rep, 0), peak)
+            for bucket, b in other._per_bucket.items():
+                mine = self._per_bucket.setdefault(
+                    bucket, {"steps": 0, "groups": 0, "phases": {}})
+                mine["steps"] += b["steps"]
+                mine["groups"] += b["groups"]
+                for phase, sec in b["phases"].items():
+                    mine["phases"][phase] = \
+                        mine["phases"].get(phase, 0.0) + sec
+            self._hbm_peak = max(self._hbm_peak, other._hbm_peak)
+            self._hbm_last = max(self._hbm_last, other._hbm_last)
+            self._by_rank[rank] = other.summary()
 
 
 def format_table(summary: Dict) -> str:
@@ -338,6 +436,12 @@ def format_table(summary: Dict) -> str:
             f"{s['p95_sec'] * 1e3:>10.3f} {s['share']:>6.1%}")
     lines.append(f"steps: {summary.get('steps', 0)}  "
                  f"dispatch groups: {summary.get('groups', 0)}")
+    # memory line (obs.memory samples): the HBM-budget number next to the
+    # time budget — absent on backends without memory_stats (CPU)
+    mem = summary.get("memory")
+    if mem:
+        lines.append(f"peak HBM {mem['gb_peak']:.3f} GB "
+                     f"(in use {mem['bytes_in_use'] / 2**30:.3f} GB)")
     # adoption line (kernel/precision): which impl the hot path actually
     # ran — `attn_impl: pallas x384` is the pallas-is-default receipt
     for key, by in summary.get("impls", {}).items():
@@ -350,12 +454,25 @@ def format_table(summary: Dict) -> str:
         if b.get("fill_mean") is not None:
             line += (f"  fill {b['fill_mean']:.2f}"
                      f" ({b.get('packed_batches', 0)} packed batch(es))")
+        if b.get("hbm_peak_gb") is not None:
+            line += f"  peak HBM {b['hbm_peak_gb']:.3f} GB"
         lines.append(line)
         for phase, s in b["phases"].items():
             lines.append(
                 f"  {phase:<12} {s['count']:>6d}x {s['total_sec']:>10.3f}s "
                 f"total {s['mean_sec'] * 1e3:>10.3f} ms mean "
                 f"{s['p95_sec'] * 1e3:>10.3f} ms p95")
+    # per-rank lines (merged multi-rank traces): each rank's step count,
+    # wall share, and peak HBM — a stalled or memory-pressured rank reads
+    # as ITSELF, not as a gang-average smear
+    for rank, s in summary.get("by_rank", {}).items():
+        total = sum(p["total_sec"] for p in s.get("phases", {}).values())
+        line = (f"rank {rank}: {s.get('steps', 0)} steps / "
+                f"{s.get('groups', 0)} groups  {total:.3f}s traced")
+        rmem = s.get("memory")
+        if rmem:
+            line += f"  peak HBM {rmem['gb_peak']:.3f} GB"
+        lines.append(line)
     # per-bucket breakdown (length-aware runs): one line per bucket x
     # phase so a bucketed run's table shows where each width's time goes
     for bucket, b in summary.get("by_bucket", {}).items():

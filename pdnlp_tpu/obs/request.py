@@ -1,0 +1,221 @@
+"""Per-request distributed tracing: one ID, every hop, one reconstructable
+life.
+
+The serve tier (PRs 8-9) moves a request through admission tiers, queues,
+pack placements, dispatches, hedges, requeues and ejection re-packs — and
+until now none of those transitions shared a joinable identity: a request
+that was admitted on replica 2, stranded by a mid-storm kill, re-packed
+onto replica 0 and completed there left three disconnected span streams.
+
+This module is the identity layer:
+
+- :func:`mint_request_id` — a process-unique ``r<pid>-<n>`` ID, minted at
+  admission (``batcher``/``router`` ``submit``) and carried on the
+  ``_Request`` object through every hop;
+- :func:`record_hop` — a zero-duration tracer record (name ``"hop"``) with
+  ``request_id`` + ``hop`` attrs, recorded at each lifecycle transition.
+  On a disabled tracer it is a no-op (the untraced hot path pays one
+  attribute read);
+- :func:`hop_chain` / :func:`chains` — reconstruction over an exported
+  span stream: filter + sort one request's hops (``trace_tpu.py request
+  <id>`` fronts this);
+- :func:`chain_issues` — the integrity contract the chaos tests and the
+  ``--serve-load`` gate enforce: an accepted request's chain starts with
+  ``admit`` and ends with exactly ONE terminal hop (completion is
+  first-wins, so a hedged/requeued request must never record two).
+
+Hop vocabulary (the ``hop`` attr):
+
+====================  ====================================================
+hop                   meaning / extra attrs
+====================  ====================================================
+``admit``             admission accepted the request AND it landed in a
+                      queue — one hop, both facts (``tier``, ``replica``,
+                      ``bucket`` or ``packed``); recording two would
+                      double the per-submit tracing cost
+``pack``              pack placement assigned (``row``, ``slot``,
+                      ``replica``)
+``dispatch``          riding an executing batch (``replica``, ``bucket``,
+                      ``row`` — and ``slot`` on the packed path,
+                      ``retry`` when re-dispatched)
+``hedge``             duplicated onto a less-loaded replica
+                      (``from_replica``, ``to_replica``)
+``requeue``           moved off an ejected replica (``from_replica``,
+                      ``to_replica``, ``inflight``, ``packed`` — the
+                      eject-time re-pack carries ``packed=True``)
+``complete``          logits delivered (terminal; ``replica``)
+``deadline``          expired before execution (terminal)
+``shed``              dropped by the shed tier (terminal)
+``rejected``          refused at admission (terminal — the only hop such
+                      a request ever records)
+``failed``            completed with a non-deadline error (terminal;
+                      ``error``)
+====================  ====================================================
+"""
+from __future__ import annotations
+
+import itertools
+import os
+from typing import Dict, List, Optional, Sequence
+
+#: the span-record name every hop record carries
+HOP = "hop"
+
+#: hops that end a request's life — exactly one per accepted request
+TERMINAL_HOPS = ("complete", "deadline", "shed", "rejected", "failed")
+
+#: how many request IDs a batch-level span carries as exemplars — enough
+#: to join a slow batch back to concrete requests, bounded so a 128-wide
+#: packed batch does not bloat every span record
+EXEMPLAR_CAP = 8
+
+_counter = itertools.count(1)
+_pid_prefix: Optional[str] = None
+
+
+def mint_request_id() -> str:
+    """Process-unique request ID (``r<pid>-<n>``): the PID disambiguates
+    ranks/replicas that merge their traces, the counter is monotonic so
+    IDs are also a stable submission order within one process.  Minted on
+    EVERY ``_Request`` (traced or not), so it is prefix-cached — a few µs
+    per submit would show up in the serve p50."""
+    global _pid_prefix
+    if _pid_prefix is None:
+        _pid_prefix = f"r{os.getpid()}-"
+    return _pid_prefix + str(next(_counter))
+
+
+def record_hop(tracer, request_id: str, hop: str, **attrs) -> None:
+    """One lifecycle transition as a zero-duration tracer record
+    (``Tracer.mark`` — the hot-path fast lane).  No-op on a disabled
+    tracer — request tracing rides the same ``--trace`` switch as spans,
+    so the untraced hot path pays one attribute read."""
+    if not tracer.enabled:
+        return
+    attrs["request_id"] = request_id
+    attrs["hop"] = hop
+    tracer.mark(HOP, attrs)
+
+
+def exemplar_ids(requests: Sequence, cap: int = EXEMPLAR_CAP) -> List[str]:
+    """The bounded ``request_ids`` attr batch-level spans carry."""
+    return [r.rid for r in list(requests)[:cap]]
+
+
+# ------------------------------------------------------- reconstruction
+
+def hop_chain(records: Sequence[Dict], request_id: str) -> List[Dict]:
+    """One request's hops from a span stream, in time order (records
+    carry aligned ``t0`` after a cross-rank merge, raw tracer time from a
+    single process — both sort correctly)."""
+    hops = [r for r in records
+            if r.get("name") == HOP
+            and (r.get("attrs") or {}).get("request_id") == request_id]
+    return sorted(hops, key=lambda r: float(r.get("t0", 0.0)))
+
+
+def chains(records: Sequence[Dict]) -> Dict[str, List[Dict]]:
+    """Every request's hop chain, keyed by request ID."""
+    by_id: Dict[str, List[Dict]] = {}
+    for r in records:
+        if r.get("name") != HOP:
+            continue
+        rid = (r.get("attrs") or {}).get("request_id")
+        if rid is not None:
+            by_id.setdefault(rid, []).append(r)
+    for hops in by_id.values():
+        hops.sort(key=lambda r: float(r.get("t0", 0.0)))
+    return by_id
+
+
+def chain_issues(chain: Sequence[Dict]) -> List[str]:
+    """Integrity violations of one hop chain (empty list = complete).
+
+    A complete accepted-request chain: starts with ``admit``, contains
+    exactly ONE terminal hop, and the terminal hop is last.  (A rejected
+    request's whole chain is the single ``rejected`` hop — also
+    complete.)  Deliberately NO timestamp-order check here:
+    :func:`hop_chain`/:func:`chains` hand over chains already sorted by
+    ``t0``, so such a check could never fire — the time ordering that IS
+    enforced is the merged timeline's (``trace_tpu.py merge`` sorts, the
+    merge tests pin monotonicity)."""
+    issues: List[str] = []
+    if not chain:
+        return ["empty chain"]
+    hops = [(r.get("attrs") or {}).get("hop") for r in chain]
+    if len(hops) == 1 and hops[0] in ("rejected", "shed"):
+        return []  # refused at the door: the one hop IS the whole life
+    if hops[0] != "admit":
+        issues.append(f"first hop is {hops[0]!r}, not 'admit'")
+    terminals = [h for h in hops if h in TERMINAL_HOPS]
+    if len(terminals) == 0:
+        issues.append("no terminal hop (orphaned request)")
+    elif len(terminals) > 1:
+        issues.append(f"{len(terminals)} terminal hops (duplicate "
+                      f"completion): {terminals}")
+    else:
+        # trailing dispatch/pack hops are BENIGN: a hedge's losing copy
+        # (or a batch formed just before the monitor completed the
+        # request) may record its execution marker microseconds after
+        # the winner's terminal — that is truthful telemetry of a
+        # duplicate execution, not an integrity violation.  Anything
+        # ELSE after the terminal (a requeue, a second admit) is.
+        tail = hops[hops.index(terminals[0]) + 1:]
+        stray = [h for h in tail if h not in ("dispatch", "pack")]
+        if stray:
+            issues.append(f"hop(s) {stray} recorded after the terminal "
+                          f"{terminals[0]!r}")
+    return issues
+
+
+def validate_chains(records: Sequence[Dict],
+                    request_ids: Optional[Sequence[str]] = None) -> Dict:
+    """Chain-integrity report over a span stream: how many chains are
+    complete, which are not (and why), and how many crossed a replica
+    ejection via requeue/re-pack — the ``--serve-load`` gate's input."""
+    by_id = chains(records)
+    ids = list(request_ids) if request_ids is not None \
+        else sorted(by_id)
+    report = {"checked": len(ids), "complete": 0, "incomplete": {},
+              "requeued": 0, "repacked": 0, "hedged": 0}
+    for rid in ids:
+        chain = by_id.get(rid, [])
+        issues = chain_issues(chain)
+        if issues:
+            report["incomplete"][rid] = issues
+        else:
+            report["complete"] += 1
+        hops = [(r.get("attrs") or {}) for r in chain]
+        if any(h.get("hop") == "requeue" for h in hops):
+            report["requeued"] += 1
+        if any(h.get("hop") == "requeue" and h.get("packed")
+               for h in hops):
+            report["repacked"] += 1
+        if any(h.get("hop") == "hedge" for h in hops):
+            report["hedged"] += 1
+    return report
+
+
+def format_chain(chain: Sequence[Dict], request_id: str) -> str:
+    """The ``trace_tpu.py request <id>`` table: one line per hop with the
+    offset since admission and the duration of the hop-to-hop gap."""
+    if not chain:
+        return f"request {request_id}: no hops found"
+    t_first = float(chain[0].get("t0", 0.0))
+    header = (f"{'hop':<10} {'t+ms':>10} {'gap_ms':>10}  detail")
+    lines = [f"request {request_id}: {len(chain)} hop(s)",
+             header, "-" * len(header)]
+    prev = t_first
+    for rec in chain:
+        attrs = dict(rec.get("attrs") or {})
+        attrs.pop("request_id", None)
+        hop = attrs.pop("hop", "?")
+        t = float(rec.get("t0", 0.0))
+        detail = "  ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        lines.append(f"{hop:<10} {(t - t_first) * 1e3:>10.3f} "
+                     f"{(t - prev) * 1e3:>10.3f}  {detail}")
+        prev = t
+    issues = chain_issues(chain)
+    lines.append("chain: " + ("complete" if not issues
+                              else "INCOMPLETE — " + "; ".join(issues)))
+    return "\n".join(lines)

@@ -159,6 +159,26 @@ class Tracer:
         tid, stack = self._thread_state()
         self._record(name, t0, t1, tid, len(stack), attrs)
 
+    def mark(self, name: str, attrs: Dict) -> None:
+        """Zero-duration instant record, hot-path cheap: ONE clock read,
+        no thread-state lookup (tid 0), the caller's dict adopted as-is.
+        The per-request hop stream (``obs.request``) runs through here —
+        at serve request rates a few extra µs per record is the
+        difference between passing and failing the ``bench.py
+        --telemetry`` 1% overhead gate."""
+        if not self.enabled:
+            return
+        rec = {"name": name, "t0": self.clock(), "dur": 0.0, "tid": 0,
+               "depth": 0, "attrs": attrs}
+        # the lock is NOT optional: records()/flush() iterate the deque
+        # under it, and CPython raises "deque mutated during iteration"
+        # on a concurrent lock-free append — a mid-storm flush (replica
+        # ejection) racing hop recording would kill the flushing thread
+        with self._lock:
+            self._records.append(rec)
+        for fn in self._listeners:
+            fn(rec)
+
     def now(self) -> float:
         return self.clock()
 
@@ -229,13 +249,23 @@ class Tracer:
     def flush(self, path: Optional[str] = None) -> Optional[str]:
         """Write the ring buffer as compact JSONL (one span per line);
         returns the path written, or None when there is nowhere to write.
-        The buffer is kept — flush is a snapshot, not a drain."""
+        The buffer is kept — flush is a snapshot, not a drain.
+
+        A ``_clock_sync`` meta record (tracer clock + wall clock read
+        back-to-back) is appended so ``trace_tpu.py merge`` can align this
+        file's per-process monotonic domain against other ranks'
+        (``pdnlp_tpu.obs.merge``)."""
         path = path or self.trace_path()
         if not self.enabled or path is None:
             return None
         from pdnlp_tpu.obs.export import write_jsonl
+        from pdnlp_tpu.obs.merge import CLOCK_SYNC
 
-        write_jsonl(self.records(), path, process_index=self.pid or 0)
+        records = self.records()
+        records.append({"name": CLOCK_SYNC, "t0": self.clock(), "dur": 0.0,
+                        "tid": 0, "depth": 0,
+                        "attrs": {"wall": time.time()}})
+        write_jsonl(records, path, process_index=self.pid or 0)
         return path
 
 

@@ -73,7 +73,9 @@ class Heartbeat:
         # would start the stall clock before compilation finishes
 
     def beat(self, force: bool = False, step: Optional[int] = None,
-             steps_per_sec: Optional[float] = None) -> None:
+             steps_per_sec: Optional[float] = None,
+             hbm: Optional[int] = None,
+             hbm_peak: Optional[int] = None) -> None:
         now = self._clock()
         if not (force or (now - self._last) >= self.interval):
             return
@@ -87,10 +89,18 @@ class Heartbeat:
         if step is not None:
             self._prev = (now, int(step))
         payload: Dict = {"t": now}
+        # the tracer-clock anchor: (t, mono) read back-to-back lets
+        # trace_tpu.py merge align this rank's perf_counter span domain
+        # against other ranks' (pdnlp_tpu.obs.merge)
+        payload["mono"] = time.perf_counter()
         if step is not None:
             payload["step"] = int(step)
         if rate is not None:
             payload["steps_per_sec"] = round(float(rate), 3)
+        if hbm is not None:
+            payload["hbm"] = int(hbm)
+        if hbm_peak is not None:
+            payload["hbm_peak"] = int(hbm_peak)
         # write-then-rename: the monitor must never read a torn beat
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
@@ -166,20 +176,27 @@ class GangMonitor:
     @staticmethod
     def _progress(beats: List[Optional[Dict]]) -> Dict:
         """Gang progress metadata from the beat payloads: the SLOWEST
-        rank's step (the gang advances at its laggard's pace) and rate."""
+        rank's step (the gang advances at its laggard's pace), its rate,
+        and the HOTTEST rank's peak HBM (the budget binds at the fullest
+        device, obs.memory rides the beats)."""
         steps = []
         rates = []
+        hbm_peaks = []
         for beat in beats:
             beat = beat or {}
             if "step" in beat:
                 steps.append(int(beat["step"]))
             if "steps_per_sec" in beat:
                 rates.append(float(beat["steps_per_sec"]))
+            if "hbm_peak" in beat:
+                hbm_peaks.append(int(beat["hbm_peak"]))
         out: Dict = {}
         if steps:
             out["last_step"] = min(steps)
         if rates:
             out["steps_per_sec"] = round(min(rates), 3)
+        if hbm_peaks:
+            out["hbm_peak_gb"] = round(max(hbm_peaks) / 2**30, 3)
         return out
 
     def status(self) -> Dict:
@@ -201,6 +218,8 @@ class GangMonitor:
             parts.append(f"step {s['last_step']}")
         if "steps_per_sec" in s:
             parts.append(f"{s['steps_per_sec']} steps/s")
+        if "hbm_peak_gb" in s:
+            parts.append(f"peak HBM {s['hbm_peak_gb']} GB")
         return "[gang] " + "  ".join(parts)
 
     def poll(self) -> Optional[Dict]:

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from pdnlp_tpu.obs.request import exemplar_ids, mint_request_id, record_hop
 from pdnlp_tpu.serve.engine import InferenceEngine
 from pdnlp_tpu.serve.metrics import ServeMetrics
 
@@ -125,7 +126,7 @@ _COMPLETE_LOCK = threading.Lock()
 
 class _Request:
     __slots__ = ("ids", "bucket", "submitted", "deadline", "retries",
-                 "hedged", "_event", "_logits", "_error")
+                 "hedged", "rid", "_event", "_logits", "_error")
 
     def __init__(self, ids: List[int], bucket: int,
                  deadline: Optional[float]):
@@ -135,6 +136,11 @@ class _Request:
         self.deadline = deadline  # absolute monotonic seconds, or None
         self.retries = 0          # router: requeues after replica failure
         self.hedged = False       # router: a duplicate dispatch exists
+        # the distributed-tracing identity: minted at admission, carried
+        # through every hop (queue, pack, dispatch, requeue, completion)
+        # so ONE id reconstructs the request's whole life — trace_tpu.py
+        # request <id> (pdnlp_tpu.obs.request)
+        self.rid = mint_request_id()
         self._event = threading.Event()
         self._logits: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
@@ -413,7 +419,9 @@ class DynamicBatcher:
             self.metrics.queue_depth.set(0)
             self.metrics.queue_tokens.set(0)
         for r in leftovers:
-            r._complete(None, RuntimeError("batcher stopped"))
+            if r._complete(None, RuntimeError("batcher stopped")):
+                record_hop(self.engine.tracer, r.rid, "failed",
+                           error="batcher stopped")
 
     def __enter__(self) -> "DynamicBatcher":
         return self.start()
@@ -453,6 +461,7 @@ class DynamicBatcher:
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         req = _Request(ids, pick_bucket(len(ids), self.buckets), deadline)
+        tr = self.engine.tracer
         with self._lock:
             if self._stop or self._worker is None:
                 raise RuntimeError("batcher is not running (call start())")
@@ -462,6 +471,7 @@ class DynamicBatcher:
                 # work it brings, not by its request count
                 if self._pending_tokens + len(ids) > self.max_queue_tokens:
                     self.metrics.rejected_total.inc()
+                    record_hop(tr, req.rid, "rejected")
                     raise QueueFullError(
                         f"queue full ({self._pending_tokens}"
                         f"/{self.max_queue_tokens} tokens)")
@@ -471,12 +481,19 @@ class DynamicBatcher:
             else:
                 if self._pending >= self.max_queue:
                     self.metrics.rejected_total.inc()
+                    record_hop(tr, req.rid, "rejected")
                     raise QueueFullError(
                         f"queue full ({self._pending}/{self.max_queue})")
                 self._queues[req.bucket].append(req)
             self._pending += 1
             self.metrics.requests_total.inc()
             self.metrics.queue_depth.set(self._pending)
+            # ONE hop for admission + initial queue placement (recording
+            # two would double the per-submit tracing cost for no extra
+            # information — the attrs carry both)
+            record_hop(tr, req.rid, "admit", tier="healthy",
+                       **({"packed": True} if self.packed
+                          else {"bucket": req.bucket}))
             self._wake.notify()
         return req
 
@@ -505,8 +522,9 @@ class DynamicBatcher:
             self.metrics.deadline_expired_total.inc(len(expired))
             self.metrics.queue_depth.set(self._pending)
             for r in expired:
-                r._complete(None, DeadlineExceeded(
-                    "deadline passed while queued"))
+                if r._complete(None, DeadlineExceeded(
+                        "deadline passed while queued")):
+                    record_hop(self.engine.tracer, r.rid, "deadline")
         if self.packed:
             # token-budget flush: a full batch worth of REAL tokens queued
             # (throughput), else the oldest request aged out (latency)
@@ -598,12 +616,14 @@ class DynamicBatcher:
         # separated by however long the worker spent on the PREVIOUS batch
         # — a request whose deadline passed in that window must not ride
         # the batch (its caller already gave up) nor hold a row
+        tr = self.engine.tracer
         live = []
         for r in batch:
             if r.deadline is not None and t0 >= r.deadline:
                 self.metrics.deadline_expired_total.inc()
-                r._complete(None, DeadlineExceeded(
-                    "deadline passed while queued"))
+                if r._complete(None, DeadlineExceeded(
+                        "deadline passed while queued")):
+                    record_hop(tr, r.rid, "deadline")
             else:
                 live.append(r)
         batch = live
@@ -615,29 +635,35 @@ class DynamicBatcher:
         # request's wait (the flush-policy-visible latency); recorded in
         # the tracer's clock domain with explicit timestamps since the
         # wait began before this call
-        tr = self.engine.tracer
         if tr.enabled:
             now = tr.now()
             oldest = max(t0 - r.submitted for r in batch)
             tr.record("queue_wait", now - oldest, now, bucket=bucket,
-                      rows=len(batch))
+                      rows=len(batch), request_ids=exemplar_ids(batch))
+            for i, r in enumerate(batch):
+                record_hop(tr, r.rid, "dispatch", bucket=bucket, row=i)
         try:
             rows = self.max_batch_size  # already padded to the mesh multiple
-            logits = self.engine.infer_ids([r.ids for r in batch], bucket,
-                                           rows=rows)
+            logits = self.engine.infer_ids(
+                [r.ids for r in batch], bucket, rows=rows,
+                request_ids=[r.rid for r in batch])
             self.metrics.batches_total.inc()
             self.metrics.batch_occupancy.observe(len(batch) / rows)
             done = time.monotonic()
             for i, r in enumerate(batch):
                 self.metrics.request_latency_ms.observe(
                     (done - r.submitted) * 1e3)
-                r._complete(logits[i])
+                if r._complete(logits[i]):
+                    record_hop(tr, r.rid, "complete")
         except BaseException as e:  # noqa: BLE001 — a failed batch must
             for r in batch:        # never leave callers blocked forever
-                r._complete(None, e)
+                if r._complete(None, e):
+                    record_hop(tr, r.rid, "failed",
+                               error=type(e).__name__)
 
     def _execute_packed(self, pb: _PackedBatch) -> None:
         t0 = time.monotonic()
+        tr = self.engine.tracer
         # the batch is already packed — a corpse's tokens ride anyway —
         # but its caller gave up, so complete it with the expiry error and
         # skip its scatter rather than hand back a result nobody awaits
@@ -645,23 +671,29 @@ class DynamicBatcher:
         for r, place in zip(pb.requests, pb.placements):
             if r.deadline is not None and t0 >= r.deadline:
                 self.metrics.deadline_expired_total.inc()
-                r._complete(None, DeadlineExceeded(
-                    "deadline passed while queued"))
+                if r._complete(None, DeadlineExceeded(
+                        "deadline passed while queued")):
+                    record_hop(tr, r.rid, "deadline")
             else:
                 live.append((r, place))
         if not live:
             return
         for r, _ in live:
             self.metrics.queue_wait_ms.observe((t0 - r.submitted) * 1e3)
-        tr = self.engine.tracer
         if tr.enabled:
             now = tr.now()
             oldest = max(t0 - r.submitted for r, _ in live)
             tr.record("queue_wait", now - oldest, now,
-                      bucket=self.pack_width, rows=len(live), packed=True)
+                      bucket=self.pack_width, rows=len(live), packed=True,
+                      request_ids=exemplar_ids([r for r, _ in live]))
+            for r, (row, slot) in live:
+                record_hop(tr, r.rid, "pack", row=row, slot=slot)
+                record_hop(tr, r.rid, "dispatch", row=row, slot=slot,
+                           packed=True)
         try:
-            logits = self.engine.infer_packed(pb.arrays,
-                                              segments=len(live))
+            logits = self.engine.infer_packed(
+                pb.arrays, segments=len(live),
+                request_ids=[r.rid for r, _ in live])
             self.metrics.batches_total.inc()
             # occupancy in TOKEN slots: a packed batch always spends every
             # row, so rows would read 1.0 forever — real tokens over the
@@ -671,7 +703,10 @@ class DynamicBatcher:
             for r, (row, slot) in live:
                 self.metrics.request_latency_ms.observe(
                     (done - r.submitted) * 1e3)
-                r._complete(logits[row, slot])
+                if r._complete(logits[row, slot]):
+                    record_hop(tr, r.rid, "complete")
         except BaseException as e:  # noqa: BLE001 — a failed batch must
             for r, _ in live:      # never leave callers blocked forever
-                r._complete(None, e)
+                if r._complete(None, e):
+                    record_hop(tr, r.rid, "failed",
+                               error=type(e).__name__)

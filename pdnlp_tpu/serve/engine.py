@@ -119,6 +119,15 @@ class InferenceEngine:
         # router labels each engine with its rank here, so per-replica
         # phase tables (obs.phases) can attribute engine time per replica
         self.span_attrs: Dict[str, object] = {}
+        # HBM accounting over THIS engine's device slice (mesh devices, or
+        # every local device for plain jit): sampled per executed batch
+        # when tracing is on, and on demand for serve snapshots /
+        # /metrics.  Graceful no-op (one flag read per call) on backends
+        # without memory_stats — CPU tests run unchanged.
+        from pdnlp_tpu.obs.memory import MemorySampler
+
+        self.memory = MemorySampler(
+            devices=list(mesh.devices.flat) if mesh is not None else None)
 
         metrics_ref = self.metrics
         attn_impl = args.attention_impl
@@ -200,13 +209,43 @@ class InferenceEngine:
         self.params = self._put(host)
         self.checkpoint_path = path
 
+    def _telemetry_attrs(self, request_ids) -> Dict:
+        """Per-batch span extras: bounded ``request_ids`` exemplars (the
+        join key from a slow batch back to concrete request hop chains)
+        and the device slice's peak HBM — sampled BEFORE the span opens
+        (a pure allocator-counter read, no sync), only while tracing."""
+        extra: Dict[str, object] = {}
+        if not self.tracer.enabled:
+            return extra
+        if request_ids:
+            from pdnlp_tpu.obs.request import EXEMPLAR_CAP
+
+            extra["request_ids"] = list(request_ids)[:EXEMPLAR_CAP]
+        mem = self.memory.sample()
+        if mem is not None:
+            extra["hbm_peak"] = mem["device_peak_bytes"]
+        return extra
+
+    def memory_snapshot(self) -> Dict:
+        """JSON-ready HBM state of this engine's device slice (serve
+        snapshots / the live exporter); ``{"supported": False}`` on CPU."""
+        return self.memory.snapshot()
+
+    def beat_memory(self) -> Dict:
+        """The ``hbm``/``hbm_peak`` heartbeat fields (replica workers fold
+        these into their watchdog beats)."""
+        return self.memory.beat_payload()
+
     # ----------------------------------------------------------- forward
-    def infer(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+    def infer(self, batch: Dict[str, np.ndarray],
+              request_ids=None) -> np.ndarray:
         """Fixed-shape batch -> host logits ``[rows, num_labels]`` (fp32).
 
         Tracks the compiled-shape cache: key is the batch's
         ``(seq_len, rows)``; a first-seen key is a miss (and will trace),
         every later one a hit that replays the compiled program.
+        ``request_ids``: optional riding-request IDs, stamped (bounded)
+        on the span as exemplars.
         """
         rows, seq = batch["input_ids"].shape
         key = (int(seq), int(rows))
@@ -241,6 +280,7 @@ class InferenceEngine:
         with self.tracer.span(span_name, seq=int(seq), rows=int(rows),
                               dtype=self.dtype_label, fill=round(fill, 4),
                               attn_impl=self.routed_attn(int(seq)),
+                              **self._telemetry_attrs(request_ids),
                               **self.span_attrs):
             logits = self._jit_forward(self.params, fwd)
             out = np.asarray(jax.device_get(logits))
@@ -253,7 +293,7 @@ class InferenceEngine:
                        "segment_ids", "position_ids", "cls_positions")
 
     def infer_packed(self, batch: Dict[str, np.ndarray],
-                     segments: int = 0) -> np.ndarray:
+                     segments: int = 0, request_ids=None) -> np.ndarray:
         """Packed batch (``data.packing.pack_id_lists``) -> host logits
         ``[rows, max_segments, num_labels]`` (fp32) — one forward serving
         many requests per row.
@@ -293,18 +333,19 @@ class InferenceEngine:
                               dtype=self.dtype_label,
                               attn_impl=self.routed_attn(int(seq),
                                                          segmented=True),
+                              **self._telemetry_attrs(request_ids),
                               **self.span_attrs):
             logits = self._jit_forward(self.params, fwd)
             out = np.asarray(jax.device_get(logits))
         return out
 
     def infer_ids(self, id_lists: Sequence[Sequence[int]], seq_len: int,
-                  rows: int = 0) -> np.ndarray:
+                  rows: int = 0, request_ids=None) -> np.ndarray:
         """Ragged id-lists -> logits for the REAL rows only (filler dropped)."""
         rows = self.pad_rows(max(rows, len(id_lists)))
         batch = pad_ids_to_bucket(id_lists, seq_len, rows,
                                   pad_id=self.tokenizer.pad_id)
-        return self.infer(batch)[: len(id_lists)]
+        return self.infer(batch, request_ids=request_ids)[: len(id_lists)]
 
     def classify_texts(self, texts: Sequence[str],
                        seq_len: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:

@@ -61,13 +61,14 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from pdnlp_tpu.obs.request import exemplar_ids, record_hop
 from pdnlp_tpu.parallel.watchdog import GangMonitor, Heartbeat
 from pdnlp_tpu.serve.batcher import (
     DEFAULT_BUCKETS, AdmissionControl, DeadlineExceeded, LoadShedError,
     QueueFullError, _PackedBatch, _Request, form_packed_batch, pick_bucket,
     resolve_serve_pack, usable_buckets,
 )
-from pdnlp_tpu.serve.metrics import ReplicaMetrics, RouterMetrics
+from pdnlp_tpu.serve.metrics import ReplicaMetrics, RouterMetrics, _save_json
 from pdnlp_tpu.train.checkpoint import CorruptCheckpointError
 
 
@@ -225,6 +226,7 @@ class ReplicaRouter:
         stall_timeout: float = 10.0,
         poll_interval: float = 0.1,
         hb_dir: Optional[str] = None,
+        telemetry_dir: Optional[str] = None,
         checkpoint_path: Optional[str] = None,
         metrics: Optional[RouterMetrics] = None,
         tracer=None,
@@ -271,6 +273,10 @@ class ReplicaRouter:
         self.clock = clock
         self.health_clock = health_clock
         self.hb_dir = hb_dir or tempfile.mkdtemp(prefix="pdnlp-serve-hb-")
+        # crash-path telemetry: spans + a metrics snapshot land HERE on
+        # every ejection and on stop, so a condemned replica's last
+        # batches are on disk even when nothing exits cleanly
+        self.telemetry_dir = telemetry_dir or self.hb_dir
         self._beat_interval = min(1.0, self.stall_timeout / 5.0)
 
         self._slots = [_Slot(i) for i in range(len(engines))]
@@ -370,6 +376,7 @@ class ReplicaRouter:
         self._monitor_thread = None
         for r in leftovers:
             self._finish(r, error=RuntimeError("router stopped"))
+        self.flush_telemetry("stop")
 
     def __enter__(self) -> "ReplicaRouter":
         return self.start()
@@ -379,33 +386,47 @@ class ReplicaRouter:
 
     # ------------------------------------------------------------- metrics
     def _finish(self, r: _Request, logits=None, error=None,
-                latency: bool = False) -> bool:
+                latency: bool = False,
+                replica: Optional[int] = None) -> bool:
         """Complete ``r`` exactly once and keep the pool accounting true
         (first completion decrements pending; hedged losers are no-ops)."""
         with self._lock:
-            return self._finish_locked(r, logits, error, latency=latency)
+            return self._finish_locked(r, logits, error, latency=latency,
+                                       replica=replica)
 
     def _finish_locked(self, r: _Request, logits=None, error=None,
-                       latency: bool = False) -> bool:
+                       latency: bool = False,
+                       replica: Optional[int] = None) -> bool:
         """:meth:`_finish`'s core, for callers already holding the router
-        lock — ONE copy of the completion/error taxonomy so the counters
-        and the latency histogram the p99 gate reads cannot drift."""
+        lock — ONE copy of the completion/error taxonomy so the counters,
+        the latency histogram the p99 gate reads, and the request's
+        TERMINAL hop (exactly one per accepted request — completion is
+        first-wins) cannot drift."""
         won = r._complete(logits, error)
         if won:
             self._pending -= 1
             self._pending_tokens -= len(r.ids)
             self.metrics.queue_depth.set(self._pending)
+            hop_attrs: Dict = {}
+            if replica is not None:
+                hop_attrs["replica"] = replica
             if error is None:
                 self.metrics.completed_total.inc()
+                hop = "complete"
                 if latency:
                     self.metrics.request_latency_ms.observe(
                         (self.clock() - r.submitted) * 1e3)
             elif isinstance(error, DeadlineExceeded):
                 self.metrics.deadline_expired_total.inc()
+                hop = "deadline"
             elif isinstance(error, LoadShedError):
                 self.metrics.shed_total.inc()
+                hop = "shed"
             else:
                 self.metrics.failed_total.inc()
+                hop = "failed"
+                hop_attrs["error"] = type(error).__name__
+            record_hop(self.tracer, r.rid, hop, **hop_attrs)
             self._cond.notify_all()
         return won
 
@@ -439,12 +460,20 @@ class ReplicaRouter:
         with self._lock:
             if self._stop or not self._started:
                 raise RuntimeError("router is not running (call start())")
-            self._admit(req)
+            tier = self._admit(req)
             slot = self._pick_slot(exclude=None)
             if slot is None:
                 self.metrics.rejected_total.inc()
+                record_hop(self.tracer, req.rid, "rejected",
+                           reason="no-replica")
                 raise QueueFullError("no replica available (all ejected?)")
             self._enqueue(slot, req)
+            # ONE hop for admission + initial queue placement (the attrs
+            # carry the tier AND where the request landed)
+            record_hop(self.tracer, req.rid, "admit", tier=tier,
+                       replica=slot.index,
+                       **({"packed": True} if self.packed
+                          else {"bucket": req.bucket}))
             self.metrics.requests_total.inc()
             self._pending += 1
             self._pending_tokens += len(req.ids)
@@ -459,17 +488,19 @@ class ReplicaRouter:
         request count on the padded path."""
         return self._pending_tokens if self.packed else self._pending
 
-    def _admit(self, req: _Request) -> None:
-        """Walk the admission ladder under the lock; raises to refuse."""
+    def _admit(self, req: _Request) -> str:
+        """Walk the admission ladder under the lock; raises to refuse,
+        returns the tier the request was accepted at (its ``admit`` hop
+        attr)."""
         adm = self.admission
         waited = False
         while True:
             tier = adm.tier(self._pending_units)
             if tier == "healthy":
-                return
+                return "backpressure" if waited else "healthy"
             if tier == "backpressure":
                 if waited:
-                    return  # bounded wait paid: accept at elevated depth
+                    return tier  # bounded wait paid: accept at elevated depth
                 waited = True
                 self.metrics.backpressure_waits_total.inc()
                 wait = adm.backpressure_wait_sec(req)
@@ -484,9 +515,10 @@ class ReplicaRouter:
                     raise LoadShedError(
                         "shed: lowest deadline slack in the pool and under "
                         f"the {adm.shed_slack_ms:.0f}ms viability floor")
-                return  # accepted at shed depth (its slack is viable)
+                return tier  # accepted at shed depth (its slack is viable)
             # tier == "reject"
             self.metrics.rejected_total.inc()
+            record_hop(self.tracer, req.rid, "rejected", tier="reject")
             raise QueueFullError(
                 f"queue full ({self._pending_units}/{adm.max_queue}"
                 + (" tokens)" if self.packed else ")"))
@@ -508,7 +540,8 @@ class ReplicaRouter:
                 q[:] = [r for r in q if id(r) not in victimset]
         for r in victims:
             if r is arriving:
-                r._complete(None, LoadShedError("shed on arrival"))
+                if r._complete(None, LoadShedError("shed on arrival")):
+                    record_hop(self.tracer, r.rid, "shed", arrival=True)
                 self.metrics.shed_total.inc()
             else:
                 self._finish_locked(r, error=LoadShedError(
@@ -548,7 +581,9 @@ class ReplicaRouter:
                     raise _InjectedFault(
                         f"replica {rep.index} killed (injected)")
                 if rep.fault != "hang":  # a wedged process beats no more
-                    rep.hb.beat(step=rep.batches)
+                    mem = getattr(rep.engine, "beat_memory", None)
+                    rep.hb.beat(step=rep.batches,
+                                **(mem() if mem is not None else {}))
                 with self._lock:
                     if self._stop or rep.state == "ejected":
                         return
@@ -733,17 +768,27 @@ class ReplicaRouter:
             now = tr.now()
             oldest = max(t0 - r.submitted for r in batch)
             tr.record("queue_wait", now - oldest, now, replica=rep.index,
-                      bucket=bucket, rows=len(batch), retry=retried)
+                      bucket=bucket, rows=len(batch), retry=retried,
+                      request_ids=exemplar_ids(batch))
+            for i, r in enumerate(batch):
+                # a hedge loser may have been completed elsewhere AFTER
+                # this batch formed — a dispatch hop recorded past its
+                # terminal would read as an incomplete chain
+                if not r.done():
+                    record_hop(tr, r.rid, "dispatch", replica=rep.index,
+                               bucket=bucket, row=i, retry=r.retries)
         rows = rep.flush_rows
         logits = rep.engine.infer_ids([r.ids for r in batch], bucket,
-                                      rows=rows)
+                                      rows=rows,
+                                      request_ids=[r.rid for r in batch])
         slot = self._slots[rep.index]
         slot.metrics.batches_total.inc()
         slot.metrics.batch_occupancy.observe(len(batch) / rows)
         slot.metrics.fill_ratio.observe(
             sum(len(r.ids) for r in batch) / float(rows * bucket))
         for i, r in enumerate(batch):
-            self._finish(r, logits=logits[i], latency=True)
+            self._finish(r, logits=logits[i], latency=True,
+                         replica=rep.index)
 
     def _execute_packed(self, rep: _Replica, pb: _PackedBatch) -> None:
         """The packed twin of :meth:`_execute`: one fixed-shape packed
@@ -761,15 +806,26 @@ class ReplicaRouter:
             oldest = max(t0 - r.submitted for r in pb.requests)
             tr.record("queue_wait", now - oldest, now, replica=rep.index,
                       bucket=self.pack_width, rows=len(pb.requests),
-                      retry=retried, packed=True)
-        logits = rep.engine.infer_packed(pb.arrays,
-                                         segments=len(pb.requests))
+                      retry=retried, packed=True,
+                      request_ids=exemplar_ids(pb.requests))
+            for r, (row, seg) in zip(pb.requests, pb.placements):
+                if r.done():  # completed elsewhere since the pack formed
+                    continue
+                record_hop(tr, r.rid, "pack", replica=rep.index,
+                           row=row, slot=seg)
+                record_hop(tr, r.rid, "dispatch", replica=rep.index,
+                           row=row, slot=seg, packed=True,
+                           retry=r.retries)
+        logits = rep.engine.infer_packed(
+            pb.arrays, segments=len(pb.requests),
+            request_ids=[r.rid for r in pb.requests])
         slot = self._slots[rep.index]
         slot.metrics.batches_total.inc()
         slot.metrics.batch_occupancy.observe(pb.fill)
         slot.metrics.fill_ratio.observe(pb.fill)
         for r, (row, seg) in zip(pb.requests, pb.placements):
-            self._finish(r, logits=logits[row, seg], latency=True)
+            self._finish(r, logits=logits[row, seg], latency=True,
+                         replica=rep.index)
 
     # ------------------------------------------------------------- monitor
     def _monitor(self) -> None:
@@ -847,6 +903,9 @@ class ReplicaRouter:
                     target.replica.queues[r.bucket].append(r)
                     target.metrics.queue_depth.set(target.replica.queued())
                     self.metrics.hedges_total.inc()
+                    record_hop(self.tracer, r.rid, "hedge",
+                               from_replica=rep.index,
+                               to_replica=target.index)
                     self._cond.notify_all()
 
     def _eject(self, index: int, reason: str) -> None:
@@ -905,6 +964,9 @@ class ReplicaRouter:
                     self.metrics.requeued_total.inc()
                 slot.metrics.requeued_out.inc()
                 target.metrics.requeued_in.inc()
+                record_hop(self.tracer, r.rid, "requeue",
+                           from_replica=index, to_replica=target.index,
+                           inflight=was_inflight, packed=self.packed)
                 if self.packed:
                     # survivors RE-PACK the orphans: they join the
                     # target's token queue and ride its next packed batch
@@ -914,6 +976,11 @@ class ReplicaRouter:
                     target.replica.queues[r.bucket].append(r)
                 target.metrics.queue_depth.set(target.replica.queued())
             self._cond.notify_all()
+        # crash-path telemetry: the condemned replica's spans + a metrics
+        # snapshot land on disk NOW — ejection is the only exit a crashed
+        # worker gets, so this is its flush (outside the lock: file I/O
+        # must not serialize submitters)
+        self.flush_telemetry(f"eject replica {index} ({reason})")
 
     # ------------------------------------------------------------ recovery
     def kill_replica(self, index: int, kind: str = "crash") -> None:
@@ -1007,6 +1074,24 @@ class ReplicaRouter:
         return report
 
     # ----------------------------------------------------------- reporting
+    def flush_telemetry(self, event: str = "") -> None:
+        """Spans + a full metrics snapshot to disk (``telemetry_dir``),
+        best-effort: called from the ejection path and from ``stop`` so a
+        pool that dies mid-storm still leaves its evidence.  Telemetry
+        flushing must never take the router down with it."""
+        try:
+            self.tracer.flush()
+        except OSError:
+            pass
+        try:
+            _save_json({"event": event,
+                        "wall_time": time.time(),
+                        **self.snapshot()},
+                       os.path.join(self.telemetry_dir,
+                                    "router_snapshot.json"))
+        except OSError:
+            pass
+
     def engine(self, index: int = 0):
         """The live engine in slot ``index`` (current incarnation)."""
         rep = self._slots[index].replica
@@ -1028,8 +1113,14 @@ class ReplicaRouter:
                    if s.replica and s.replica.state != "ejected")
 
     def snapshot(self) -> Dict:
-        """Router + per-replica metrics, JSON-ready (the
-        ``results/serve_load_smoke.json`` building block)."""
+        """Router + per-replica metrics (incl. each replica's device-slice
+        HBM state), JSON-ready (the ``results/serve_load_smoke.json``
+        building block and the live exporter's ``serve`` source)."""
+        def replica_memory(s: _Slot):
+            fn = getattr(s.replica.engine, "memory_snapshot", None) \
+                if s.replica else None
+            return fn() if fn is not None else None
+
         return {
             "router": self.metrics.snapshot(),
             "replicas": {
@@ -1041,6 +1132,7 @@ class ReplicaRouter:
                     **s.metrics.snapshot(),
                     "engine": (s.replica.engine.metrics.snapshot()
                                if s.replica else None),
+                    "memory": replica_memory(s),
                 }
                 for s in self._slots
             },

@@ -286,14 +286,50 @@ class Trainer:
         # heartbeat).  tr is a no-op object when --trace is off — the
         # span/block calls below stay in place unconditionally.
         tr = self.tracer
-        breakdown = detector = None
+        breakdown = detector = sampler = None
         if tr.enabled:
-            from pdnlp_tpu.obs import RegressionDetector, StepBreakdown
+            from pdnlp_tpu.obs import (
+                MemorySampler, RegressionDetector, StepBreakdown,
+            )
 
             detector = RegressionDetector(
                 on_event=lambda ev: rank0_print(f"[obs] {ev}"))
             breakdown = StepBreakdown(on_step=detector.observe)
             tr.add_listener(breakdown.feed)
+            # HBM accounting at phase boundaries: the sampler listens for
+            # device_block/eval/ckpt_save records and reads the allocator
+            # counters (pure host calls — no sync); samples land back in
+            # the trace as "hbm" records, so the breakdown table, merged
+            # traces and the heartbeat all carry the memory columns.  On
+            # backends without memory_stats (CPU) the first sample flips
+            # it to a permanent no-op.
+            sampler = MemorySampler(tracer=tr)
+            tr.add_listener(sampler.feed)
+        # live telemetry (--metrics_port / --flight_recorder): Prometheus
+        # /metrics + JSON /healthz served off the hot path, plus a bounded
+        # flight-recorder JSONL appending snapshots so a SIGKILL'd run
+        # still leaves evidence.  Sources snapshot live objects at scrape
+        # time; the step loop never sees the exporter.
+        exporter = None
+        if getattr(args, "metrics_port", 0) \
+                or getattr(args, "flight_recorder", None):
+            from pdnlp_tpu.obs import memory_snapshot
+            from pdnlp_tpu.obs.exporter import build_from_args
+
+            sources = {"memory": (sampler.snapshot if sampler is not None
+                                  else memory_snapshot)}
+            if breakdown is not None:
+                sources["train"] = breakdown.summary
+            if self.pipeline is not None \
+                    and getattr(self.pipeline, "stats", None) is not None:
+                sources["transport"] = self.pipeline.stats.snapshot
+            pidx = jax.process_index()
+            exporter = build_from_args(
+                args, sources, f"flight_proc{pidx}.jsonl",
+                process_index=pidx)
+            if exporter is not None and exporter.port is not None:
+                rank0_print(f"[obs] /metrics + /healthz on "
+                            f"http://127.0.0.1:{exporter.port}")
         # the listener must detach even when the loop raises (resume
         # mismatch, fault injection, KeyboardInterrupt): a stale feed
         # on the process-global tracer would double-count every span
@@ -423,7 +459,9 @@ class Trainer:
                         heartbeat.beat(
                             step=gstep,
                             steps_per_sec=detector.steps_per_sec
-                            if detector is not None else None)
+                            if detector is not None else None,
+                            **(sampler.beat_payload()
+                               if sampler is not None else {}))
                     if resume_every and gstep // resume_every != prev // resume_every:
                         # async (default): the span covers the device->host
                         # snapshot + enqueue only — serialization and disk
@@ -482,6 +520,16 @@ class Trainer:
         finally:
             if breakdown is not None:
                 tr.remove_listener(breakdown.feed)
+            if sampler is not None:
+                tr.remove_listener(sampler.feed)
+            if exporter is not None:
+                # final flight-recorder snapshot + shutdown on EVERY exit
+                # path: a run that raises must still leave its last
+                # metrics line on disk
+                try:
+                    exporter.stop(final_flight=True)
+                except Exception:
+                    pass
             if self._ckpt_writer is not None:
                 # exception path: best-effort drain (bounded) so the newest
                 # snapshot survives the failure; errors here must not mask
@@ -490,15 +538,28 @@ class Trainer:
                     self._ckpt_writer.wait(timeout=60.0)
                 except Exception:
                     pass
-        if breakdown is not None:
-            from pdnlp_tpu.obs import format_table
+            if breakdown is not None:
+                # crash-path flush: the ring + summary land on disk from
+                # the finally, so a raising train() (fault injection,
+                # preemption, resume mismatch) never silently loses its
+                # last steps' spans.  Guarded — telemetry flushing must
+                # not mask the original exception — but a flush failure
+                # is PRINTED, never swallowed: on a clean run a disk-full
+                # OSError here would otherwise surface later as a
+                # confusing missing trace_summary.
+                try:
+                    from pdnlp_tpu.obs import format_table
 
-            breakdown.close()
-            self.trace_summary = breakdown.summary()
-            path = tr.flush()
-            rank0_print("[obs] phase breakdown:\n"
-                        + format_table(self.trace_summary)
-                        + (f"\n[obs] spans -> {path}" if path else ""))
+                    breakdown.close()
+                    self.trace_summary = breakdown.summary()
+                    path = tr.flush()
+                    rank0_print("[obs] phase breakdown:\n"
+                                + format_table(self.trace_summary)
+                                + (f"\n[obs] spans -> {path}"
+                                   if path else ""))
+                except Exception as flush_err:  # noqa: BLE001
+                    rank0_print(f"WARNING: trace flush failed: "
+                                f"{type(flush_err).__name__}: {flush_err}")
         if hooks.on_end is not None:
             hooks.on_end()  # durability work that must count in the runtime
         minutes = (time.time() - start) / 60

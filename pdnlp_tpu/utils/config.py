@@ -206,6 +206,23 @@ class Args:
     trace_dir: Optional[str] = None               # span files (trace_proc
                                                   # <i>.jsonl); default
                                                   # <output_dir>/trace
+    metrics_port: int = 0                         # live telemetry (obs.
+                                                  # exporter): Prometheus
+                                                  # /metrics + JSON
+                                                  # /healthz on this port,
+                                                  # served off the hot
+                                                  # path; 0 = off.  Also
+                                                  # turns on the flight
+                                                  # recorder (default
+                                                  # path under
+                                                  # <output_dir>/telemetry)
+    flight_recorder: Optional[str] = None         # bounded JSONL a
+                                                  # background thread
+                                                  # appends metric
+                                                  # snapshots to, so a
+                                                  # SIGKILL'd run leaves
+                                                  # evidence; settable
+                                                  # without --metrics_port
     profile_dir: Optional[str] = None             # jax.profiler trace output
     warmup_compile: bool = False                  # AOT-compile steps before
                                                   # the timed epoch (bench

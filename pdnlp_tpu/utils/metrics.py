@@ -91,10 +91,19 @@ class Histogram:
                 self._pos = (self._pos + 1) % self._window
 
     def percentile(self, p: float) -> Optional[float]:
+        return (self.percentiles((p,)) or [None])[0]
+
+    def percentiles(self, ps: Sequence[float]) -> Optional[List[float]]:
+        """All requested percentiles over ONE window copy — a live
+        ``/metrics`` scrape reads p50/p95/p99 of five histograms per
+        tick, and converting the 8k-observation window per percentile
+        (3x per histogram) was measurable GIL/lock pressure against the
+        serve worker (``bench.py --telemetry``)."""
         with self._lock:
             if not self._recent:
                 return None
-            return float(np.percentile(np.asarray(self._recent), p))
+            window = np.asarray(self._recent)
+        return [float(v) for v in np.percentile(window, list(ps))]
 
     @property
     def mean(self) -> Optional[float]:
@@ -102,14 +111,15 @@ class Histogram:
 
     def snapshot(self) -> Dict[str, Optional[float]]:
         """JSON-ready summary: count/mean/min/max + p50/p95/p99."""
+        ps = self.percentiles((50, 95, 99)) or [None, None, None]
         return {
             "count": self.count,
             "mean": self.mean,
             "min": self.min,
             "max": self.max,
-            "p50": self.percentile(50),
-            "p95": self.percentile(95),
-            "p99": self.percentile(99),
+            "p50": ps[0],
+            "p95": ps[1],
+            "p99": ps[2],
         }
 
 

@@ -40,6 +40,14 @@ scales with tokens, not requests; flush policy and queue admission move to
 token units.  ``auto`` (default) packs where the segment-native pallas
 kernel routes (TPU); ``off`` keeps the per-bucket padded path.
 
+Live telemetry: ``--metrics_port 9100`` (an ``Args`` field) serves
+Prometheus ``/metrics`` + JSON ``/healthz`` off the hot path and appends
+bounded flight-recorder snapshots (``--flight_recorder`` overrides the
+path) so a SIGKILL'd server still leaves evidence; ``--trace true``
+additionally records spans AND per-request hop chains (every request's
+admission → queue → dispatch → completion life is reconstructable by
+``trace_tpu.py request <id>``).
+
 Serve-local flags (not ``Args`` fields): ``--checkpoint`` (default: newest
 under ``--output_dir``), ``--buckets 32,64,128``, ``--max_batch_size``,
 ``--max_wait_ms``, ``--max_queue``, ``--deadline_ms``, ``--replicas``,
@@ -205,13 +213,35 @@ def main(argv=None) -> None:
         engine = build_engine(args, checkpoint=checkpoint,
                               use_mesh=not no_mesh)
 
+    # live telemetry (--metrics_port / --flight_recorder): Prometheus
+    # /metrics + JSON /healthz off the hot path, plus the bounded
+    # flight-recorder JSONL so a SIGKILL'd server still leaves evidence
+    exporter = None
+    if args.metrics_port or args.flight_recorder:
+        from pdnlp_tpu.obs import memory_snapshot
+        from pdnlp_tpu.obs.exporter import build_from_args
+
+        sources = ({"serve": router.snapshot} if router is not None
+                   else {"serve": engine.metrics.snapshot,
+                         "memory": engine.memory_snapshot})
+        if router is not None:
+            sources["memory"] = memory_snapshot
+        exporter = build_from_args(args, sources, "flight_serve.jsonl")
+        if exporter is not None and exporter.port is not None:
+            rank0_print(f"[obs] /metrics + /healthz on "
+                        f"http://127.0.0.1:{exporter.port}",
+                        file=sys.stderr)
+
     def flush_artifacts(extra=None) -> None:
         """Metrics snapshot + trace spans land on disk on EVERY exit path
         — a drained shutdown that loses its telemetry only half happened."""
         import json
 
+        if exporter is not None:
+            exporter.stop(final_flight=True)  # last flight line first
         snap = router.snapshot() if router is not None \
-            else engine.metrics.snapshot()
+            else {**engine.metrics.snapshot(),
+                  "memory": engine.memory_snapshot()}
         if extra:
             snap = {**snap, **extra}
         if metrics_path:

@@ -1,0 +1,31 @@
+"""R12 negatives: host values, static reads, and the sanctioned
+materialize-at-the-barrier-then-attach shape."""
+import jax
+
+
+def host_attrs(tracer, step, state, batch, gstep):
+    state, metrics = step(state, batch)
+    with tracer.span("step_dispatch", step=gstep, n=1):
+        pass
+    return state, metrics
+
+
+def static_reads_are_fine(tracer, engine, batch):
+    logits = engine._jit_forward(engine.params, batch)
+    with tracer.span("forward", rows=logits.shape[0], n=len(batch)):
+        out = jax.device_get(logits)
+    return out
+
+
+def materialized_at_the_barrier(tracer, step, state, batch):
+    state, metrics = step(state, batch)
+    loss_host = float(jax.device_get(metrics["loss"]))  # the sync point
+    with tracer.span("log", loss=loss_host):  # host data: fine
+        pass
+    return state
+
+
+def block_is_the_sanctioned_api(tracer, step, state, batch, gstep):
+    state, metrics = step(state, batch)
+    tracer.block(metrics["loss"], step=gstep)  # value arg, not an attr
+    return state

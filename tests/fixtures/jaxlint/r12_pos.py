@@ -1,0 +1,31 @@
+"""R12 positives: traced/device values reaching span/record attrs."""
+import jax  # noqa: F401
+
+
+def raw_device_attr(tracer, step, state, batch):
+    state, metrics = step(state, batch)
+    with tracer.span("log", loss=metrics["loss"]):  # line 7: device attr
+        pass
+    return state
+
+
+def synced_in_attr(tracer, step, state, batch):
+    state, metrics = step(state, batch)
+    with tracer.span("log", loss=float(metrics["loss"])):  # line 14: sync
+        pass                                               # inside region
+    return state
+
+
+def forward_result_in_record(tracer, engine, batch):
+    logits = engine._jit_forward(engine.params, batch)
+    t = tracer.now()
+    tracer.record("queue_wait", t, t, peek=logits[0])  # line 22
+    return logits
+
+
+def propagated_device_value(tracer, step, state, batch):
+    state, metrics = step(state, batch)
+    last = metrics["loss"]  # still a device value
+    with tracer.span("log", loss=last):  # line 29
+        pass
+    return state

@@ -222,6 +222,42 @@ def test_r11_hint_names_the_packing_surface():
     assert "cls_positions" in f.hint and "pack_id_lists" in f.hint
 
 
+def test_r12_device_value_in_span_attr_positive():
+    # raw device attr (7), float() sync inside the span call (14), a
+    # dispatch result in a record attr (22), and the same through a
+    # propagated variable (29)
+    assert all_hits("r12_pos.py") == [("R12", 7), ("R12", 14),
+                                      ("R12", 22), ("R12", 29)]
+
+
+def test_r12_device_value_in_span_attr_negative():
+    # host attrs, static .shape/len reads, the materialize-at-the-barrier
+    # shape (float(jax.device_get(...)) LAUNDERS for propagation), and
+    # Tracer.block's value argument
+    assert hits("r12_neg.py", "R12") == []
+
+
+def test_r12_requires_jax_module(tmp_path):
+    """A module that never imports jax has no device values — its span
+    attrs are host data by construction."""
+    p = tmp_path / "hostonly.py"
+    p.write_text(
+        "def f(tracer, step, state, batch):\n"
+        "    state, metrics = step(state, batch)\n"
+        "    with tracer.span('log', loss=metrics['loss']):\n"
+        "        pass\n"
+        "    return state\n")
+    assert [f for f in analyze_paths([str(p)], root=str(tmp_path))
+            if f.rule_id == "R12"] == []
+
+
+def test_r12_hint_names_the_barrier():
+    path = os.path.join(FIXTURES, "r12_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R12"][0]
+    assert "device_get" in f.hint and "block" in f.hint
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -231,9 +267,9 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    # the registry sorts by id STRING (R10/R11 between R1 and R2)
-    assert list(all_rules()) == ["R1", "R10", "R11", "R2", "R3", "R4", "R5",
-                                 "R6", "R7", "R8", "R9"]
+    # the registry sorts by id STRING (R10/R11/R12 between R1 and R2)
+    assert list(all_rules()) == ["R1", "R10", "R11", "R12", "R2", "R3",
+                                 "R4", "R5", "R6", "R7", "R8", "R9"]
 
 
 # -------------------------------------------------------------- suppressions

@@ -49,7 +49,7 @@ class FakeEngine:
     def pad_rows(self, n):
         return int(n)
 
-    def infer_ids(self, id_lists, seq, rows=0):
+    def infer_ids(self, id_lists, seq, rows=0, request_ids=None):
         if self.latency:
             time.sleep(self.latency)
         self.calls.append((len(id_lists), int(seq)))

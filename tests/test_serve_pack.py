@@ -248,7 +248,7 @@ class FakePackEngine(FakeEngine):
     def warmup_packed(self, seq_len, rows, max_segments):
         self.calls.append(("warm_packed", int(seq_len), int(rows)))
 
-    def infer_packed(self, arrays, segments=0):
+    def infer_packed(self, arrays, segments=0, request_ids=None):
         rows, seq = arrays["input_ids"].shape
         M = arrays["cls_positions"].shape[1]
         if self.latency:

@@ -1,14 +1,26 @@
 #!/usr/bin/env python
-"""trace_tpu.py — inspect, diff, and convert ``pdnlp_tpu.obs`` traces.
+"""trace_tpu.py — inspect, diff, merge, and convert ``pdnlp_tpu.obs``
+traces.
 
 Subcommands:
 
 - ``summarize <trace>`` — the per-phase table (count / total / mean / p50
-  / p95 / share) of one trace file;
+  / p95 / share) of one trace file; a merged multi-rank trace additionally
+  prints per-rank lines (steps, traced wall, peak HBM);
 - ``diff <base> <candidate>`` — per-phase mean deltas between two traces;
   exits **1** when any phase's mean grew beyond ``--threshold`` (default
   0.20 = 20%) — the CI guard: run a traced smoke on main and on a PR, diff
   the two files, and a phase regression fails the job with the phase named;
+- ``merge <trace_proc0.jsonl> <trace_proc1.jsonl> ... -o merged.json`` —
+  align per-process monotonic clocks (flush-time ``_clock_sync`` records,
+  falling back to heartbeat beat payloads via ``--hb_dir``) and emit ONE
+  Perfetto timeline with ``pid`` = rank; ``--jsonl`` keeps the span-log
+  format instead (feedable back into ``summarize``/``diff``/``request``);
+- ``request <id> <trace...>`` — the hop chain of one served request
+  (minted at batcher/router admission): admission tier, queue, pack
+  placement ``(row, slot)``, dispatch, hedge/requeue/re-pack, completion —
+  with per-hop gap durations; exits 1 when the chain is missing or
+  incomplete;
 - ``export <trace> -o out.json`` — convert a compact JSONL span log to
   Chrome-trace JSON (load it at https://ui.perfetto.dev or
   ``chrome://tracing``).
@@ -19,6 +31,8 @@ Pure stdlib — runs on hosts without jax installed.
 
     python trace_tpu.py summarize output/trace/trace_proc0.jsonl
     python trace_tpu.py diff main.jsonl pr.jsonl --threshold 0.2
+    python trace_tpu.py merge output/trace/trace_proc*.jsonl -o merged.json
+    python trace_tpu.py request r12345-7 output/trace/trace_proc0.jsonl
     python trace_tpu.py export output/trace/trace_proc0.jsonl -o t.json
 """
 from __future__ import annotations
@@ -27,13 +41,31 @@ import argparse
 import json
 import sys
 
-from pdnlp_tpu.obs.export import load_records, write_chrome_trace
+from pdnlp_tpu.obs.export import (
+    load_records, write_chrome_trace, write_jsonl,
+)
+from pdnlp_tpu.obs.merge import merge_traces
 from pdnlp_tpu.obs.phases import StepBreakdown, format_table
 from pdnlp_tpu.obs.regress import diff_breakdowns
+from pdnlp_tpu.obs.request import chain_issues, format_chain, hop_chain
 
 
 def _summary(path: str):
     return StepBreakdown.from_records(load_records(path)).summary()
+
+
+def _load_many(paths, hb_dir=None):
+    """One or many trace files -> one record stream (clock-aligned when
+    several files merge; a file with no clock source gets the same loud
+    warning ``merge`` prints — its spans sort on an incomparable clock)."""
+    if len(paths) == 1:
+        return load_records(paths[0])
+    records, report = merge_traces(paths, hb_dir=hb_dir)
+    if not report["aligned"]:
+        print("WARNING: some files had no _clock_sync record or "
+              "heartbeat (--hb_dir) — cross-file ordering is unreliable",
+              file=sys.stderr)
+    return records
 
 
 def cmd_summarize(ns) -> int:
@@ -87,6 +119,39 @@ def cmd_diff(ns) -> int:
     return 0
 
 
+def cmd_merge(ns) -> int:
+    records, report = merge_traces(ns.traces, hb_dir=ns.hb_dir)
+    out = ns.output or "merged.trace.json"
+    if ns.jsonl:
+        write_jsonl(records, out)
+    else:
+        write_chrome_trace(records, out)
+    for f in report["files"]:
+        off = (f"offset {f['offset_s']:+.6f}s via {f['clock_source']}"
+               if f["offset_s"] is not None else "UNALIGNED (no clock "
+               "source — offset 0 assumed)")
+        print(f"rank {f['rank']}: {f['path']}  {off}")
+    print(f"wrote {out} — {report['records']} spans over ranks "
+          f"{report['ranks']}"
+          + ("" if ns.jsonl else " (pid = rank; load it at "
+             "https://ui.perfetto.dev)"))
+    if not report["aligned"]:
+        print("WARNING: some files had no _clock_sync record or heartbeat "
+              "(--hb_dir) — their spans merged unaligned", file=sys.stderr)
+    return 0
+
+
+def cmd_request(ns) -> int:
+    records = _load_many(ns.traces, hb_dir=ns.hb_dir)
+    chain = hop_chain(records, ns.id)
+    if ns.json:
+        print(json.dumps({"request_id": ns.id, "hops": chain,
+                          "issues": chain_issues(chain)}, indent=2))
+    else:
+        print(format_chain(chain, ns.id))
+    return 0 if chain and not chain_issues(chain) else 1
+
+
 def cmd_export(ns) -> int:
     out = ns.output or (ns.trace.rsplit(".", 1)[0] + ".chrome.json")
     write_chrome_trace(load_records(ns.trace), out)
@@ -128,6 +193,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "step loop; exit 1 when exceeded")
     d.add_argument("--json", action="store_true")
     d.set_defaults(fn=cmd_diff)
+
+    m = sub.add_parser("merge", help="align + merge per-process traces "
+                                     "into one Perfetto timeline "
+                                     "(pid = rank)")
+    m.add_argument("traces", nargs="+",
+                   help="trace_proc<i>.jsonl files (rank from filename)")
+    m.add_argument("-o", "--output", default=None,
+                   help="output path (default merged.trace.json)")
+    m.add_argument("--hb_dir", default=None,
+                   help="heartbeat dir (watchdog beats carry the wall/"
+                        "mono clock pair) — the alignment fallback when a "
+                        "trace has no _clock_sync record")
+    m.add_argument("--jsonl", action="store_true",
+                   help="emit a span-log JSONL instead of Chrome-trace "
+                        "JSON (summarize/diff/request consume it)")
+    m.set_defaults(fn=cmd_merge)
+
+    r = sub.add_parser("request", help="one request's hop chain with "
+                                       "per-hop durations")
+    r.add_argument("id", help="the request id (r<pid>-<n>)")
+    r.add_argument("traces", nargs="+",
+                   help="trace file(s); several are clock-aligned first")
+    r.add_argument("--hb_dir", default=None)
+    r.add_argument("--json", action="store_true")
+    r.set_defaults(fn=cmd_request)
 
     e = sub.add_parser("export", help="JSONL span log -> Chrome-trace JSON")
     e.add_argument("trace")
