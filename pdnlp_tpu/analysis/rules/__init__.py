@@ -16,4 +16,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r10_unspanned_serve_block,
     r11_unpacked_serve_forward,
     r12_device_span_attr,
+    r13_unrecorded_actuation,
 )

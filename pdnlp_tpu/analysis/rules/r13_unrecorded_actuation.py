@@ -1,0 +1,100 @@
+"""R13 — control-plane knob writes outside the decision-recording path.
+
+The serve control plane's contract (the controller PR) is that EVERY
+actuation of a serving knob — ``hedge_ms``, ``max_wait_ms``, the admission
+thresholds, the replica count — passes through
+:meth:`ServeController._actuate`: the one choke point that enforces the
+clamp range, cooldown, hysteresis and backoff hold, AND records the
+hop-style decision chain (:mod:`pdnlp_tpu.obs.decision`) that lets
+``trace_tpu.py decisions`` explain why capacity changed.  A knob write
+that bypasses it is an *unrecorded actuation*: the system's behavior
+changes with no decision record, no safety clamp, and no evaluation
+window to auto-revert it — the unaccountable-autotuner bug class.
+
+Heuristic, controller-scope modules only (a module that imports from
+``pdnlp_tpu.serve.controller`` — or is it): flag
+
+- assignments (plain or augmented) to an attribute named like a tuning
+  knob (``x.hedge_ms = ...``, ``adm.backpressure_at *= 2``), and
+- direct calls to the router's raw setter surface
+  (``.apply_knob(...)``, ``.deactivate_replica(...)``,
+  ``.activate_replica(...)``)
+
+anywhere outside a function named ``_actuate`` or ``_apply`` (the
+controller's applier that only ``_actuate`` calls).  Modules that never
+touch the controller are out of scope — the router/batcher themselves own
+these attributes (their ``__init__``/``apply_knob`` ARE the setter
+surface), and test files are not on the lint surface.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pdnlp_tpu.analysis.core import Finding, ModuleInfo, Rule, register
+
+#: the attributes the control plane owns once a controller is in play
+_TUNING_ATTRS = {"hedge_ms", "max_wait_ms", "backpressure_at", "shed_at",
+                 "shed_slack_ms"}
+
+#: the router's raw actuation surface — sanctioned only beneath _actuate
+_ACTUATION_CALLS = {"apply_knob", "deactivate_replica", "activate_replica"}
+
+#: functions that ARE the decision-record path
+_SANCTIONED = {"_actuate", "_apply"}
+
+
+@register
+class UnrecordedActuation(Rule):
+    rule_id = "R13"
+    name = "unrecorded-actuation"
+    hint = ("route the change through the controller's decision-recording "
+            "choke point — `self._actuate(knob, value, cause)` (or "
+            "`ServeController.inject` from test/chaos code) — so it is "
+            "clamped, cooldown/hold-guarded, recorded as a decision chain "
+            "(pdnlp_tpu.obs.decision) and auto-reverted if it regresses "
+            "the SLO; raw `apply_knob`/attribute writes bypass all four")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not self._controller_module(mod):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr in _TUNING_ATTRS \
+                            and not self._sanctioned(mod, node):
+                        yield self.finding(
+                            mod, node,
+                            f"tuning attribute '{t.attr}' written outside "
+                            "the _actuate decision-record path — an "
+                            "unrecorded, unclamped, unevaluated actuation")
+                        break
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _ACTUATION_CALLS \
+                    and not self._sanctioned(mod, node):
+                yield self.finding(
+                    mod, node,
+                    f"raw actuation call '{node.func.attr}()' outside the "
+                    "_actuate decision-record path — the knob changes "
+                    "with no decision record and no evaluation window")
+
+    @staticmethod
+    def _controller_module(mod: ModuleInfo) -> bool:
+        if "pdnlp_tpu/serve/controller" in mod.path:
+            return True
+        return any(v.startswith("pdnlp_tpu.serve.controller")
+                   or v.endswith(".ServeController")
+                   for v in mod.aliases.values())
+
+    @staticmethod
+    def _sanctioned(mod: ModuleInfo, node: ast.AST) -> bool:
+        fn = mod.enclosing_function(node)
+        while fn is not None:
+            if getattr(fn, "name", None) in _SANCTIONED:
+                return True
+            fn = mod.enclosing_function(fn)
+        return False

@@ -106,7 +106,9 @@ def prometheus_text(snapshots: Dict[str, Dict],
 
 def build_from_args(args, sources: Dict[str, Callable[[], Dict]],
                     default_flight_name: str,
-                    process_index: int = 0) -> Optional["MetricsExporter"]:
+                    process_index: int = 0,
+                    health_sources: Optional[Dict[str, Callable[[], Dict]]]
+                    = None) -> Optional["MetricsExporter"]:
     """``--metrics_port``/``--flight_recorder`` -> a STARTED exporter, or
     None when neither is set — ONE wiring shared by ``Trainer.train`` and
     ``serve_tpu.py`` so the defaults cannot drift.
@@ -129,7 +131,8 @@ def build_from_args(args, sources: Dict[str, Callable[[], Dict]],
         return MetricsExporter(
             sources,
             port=(port or None) if process_index == 0 else None,
-            flight_path=flight).start()
+            flight_path=flight,
+            health_sources=health_sources).start()
     except OSError as e:
         print(f"WARNING: metrics exporter disabled — {e} (is the port "
               "held by another run?); the workload continues without "
@@ -149,8 +152,14 @@ class MetricsExporter:
                  flight_path: Optional[str] = None,
                  flight_interval_s: float = 10.0,
                  flight_max_records: int = 2048,
+                 health_sources: Optional[Dict[str, Callable[[], Dict]]]
+                 = None,
                  prefix: str = "pdnlp"):
         self.sources = dict(sources)
+        #: named callables whose SMALL summary dicts ride /healthz — the
+        #: at-a-glance state (e.g. the serve controller's knob/hold/revert
+        #: summary) a probe wants without parsing the full /metrics dump
+        self.health_sources = dict(health_sources or {})
         self.host = host
         self.port = port
         self.prefix = prefix
@@ -180,7 +189,7 @@ class MetricsExporter:
         return prometheus_text(self.collect(), prefix=self.prefix)
 
     def healthz(self) -> Dict:
-        return {
+        out = {
             "status": "ok",
             "uptime_s": round(time.monotonic() - self._started_at, 1)
             if self._started_at is not None else 0.0,
@@ -188,6 +197,12 @@ class MetricsExporter:
             "scrapes": self.scrapes,
             "flight_records": self._flight_lines,
         }
+        for name, fn in self.health_sources.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # noqa: BLE001 — one sick summary must
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "MetricsExporter":

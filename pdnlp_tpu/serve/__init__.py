@@ -20,7 +20,16 @@ requests) — this package applies the same treatment to inference:
   (plus router/per-replica instruments), JSON-snapshot compatible with the
   ``results/`` artifacts;
 - :mod:`pdnlp_tpu.serve.offline` — high-throughput whole-file scoring over
-  the same bucketing (the deterministic surface tests and ``bench.py`` use).
+  the same bucketing (the deterministic surface tests and ``bench.py`` use);
+- :mod:`pdnlp_tpu.serve.controller` — the feedback control plane: a
+  :class:`ServeController` thread that closes the telemetry loop, auto-
+  tuning replica count (warm-standby scaling), ``hedge_ms``, the flush age
+  and the admission thresholds through one decision-recording, auto-
+  reverting ``_actuate`` choke point (``serve_tpu.py --controller on``);
+- :mod:`pdnlp_tpu.serve.replay` — trace-driven load replay: recorded
+  request-hop chains reconstructed into arrival schedules, reshaped
+  (steady / diurnal ramp / flash crowd) and re-driven at 1x/5x/20x speed
+  (``bench.py --replay``).
 
 Entry point: ``serve_tpu.py`` at the repo root.
 """
@@ -28,6 +37,7 @@ from pdnlp_tpu.serve.batcher import (  # noqa: F401
     DEFAULT_BUCKETS, AdmissionControl, DeadlineExceeded, DynamicBatcher,
     LoadShedError, QueueFullError, pick_bucket, resolve_serve_pack,
 )
+from pdnlp_tpu.serve.controller import KnobSpec, ServeController  # noqa: F401
 from pdnlp_tpu.serve.engine import InferenceEngine  # noqa: F401
 from pdnlp_tpu.serve.metrics import (  # noqa: F401
     ReplicaMetrics, RouterMetrics, ServeMetrics,
@@ -43,12 +53,14 @@ __all__ = [
     "DeadlineExceeded",
     "DynamicBatcher",
     "InferenceEngine",
+    "KnobSpec",
     "LoadShedError",
     "QueueFullError",
     "ReplicaFailedError",
     "ReplicaMetrics",
     "ReplicaRouter",
     "RouterMetrics",
+    "ServeController",
     "ServeMetrics",
     "pick_bucket",
     "resolve_serve_pack",

@@ -490,8 +490,12 @@ class DynamicBatcher:
             self.metrics.queue_depth.set(self._pending)
             # ONE hop for admission + initial queue placement (recording
             # two would double the per-submit tracing cost for no extra
-            # information — the attrs carry both)
+            # information — the attrs carry both); tokens + deadline ride
+            # along for serve.replay's arrival reconstruction
             record_hop(tr, req.rid, "admit", tier="healthy",
+                       tokens=len(ids),
+                       **({} if deadline_ms is None
+                          else {"deadline_ms": float(deadline_ms)}),
                        **({"packed": True} if self.packed
                           else {"bucket": req.bucket}))
             self._wake.notify()
@@ -596,6 +600,28 @@ class DynamicBatcher:
             self._execute(batch)
             with self._lock:
                 self._wake.notify_all()  # unblock stop(drain=True) waiters
+
+    #: the single-replica tuning surface (the router has the full set);
+    #: ONE setter so controller-side writes stay auditable (jaxlint R13)
+    KNOBS = ("max_wait_ms", "max_queue")
+
+    def apply_knob(self, name: str, value) -> None:
+        """Thread-safe setter for the batcher's tunable knobs, effective
+        at the next flush decision."""
+        with self._lock:
+            if name == "max_wait_ms":
+                self.max_wait_ms = float(value)
+            elif name == "max_queue":
+                self.max_queue = int(value)
+                self.max_queue_tokens = self.max_queue * self.pack_width
+            else:
+                raise KeyError(f"unknown knob {name!r} (tunable: "
+                               f"{self.KNOBS})")
+            self._wake.notify_all()
+
+    def knob_values(self) -> Dict[str, float]:
+        return {"max_wait_ms": self.max_wait_ms,
+                "max_queue": self.max_queue}
 
     def warmup(self) -> None:
         """Pre-trace every shape live traffic can reach: the single fixed

@@ -166,6 +166,8 @@ class RouterMetrics:
         self.reintegrations_total = Counter()
         self.swaps_total = Counter()
         self.swap_rollbacks_total = Counter()
+        self.scale_downs_total = Counter()     # control plane: healthy ->
+        self.scale_ups_total = Counter()       # warm standby and back
         self.queue_depth = Gauge()             # pool-wide pending
         self.request_latency_ms = Histogram()
         self.queue_wait_ms = Histogram()
@@ -190,6 +192,8 @@ class RouterMetrics:
             "reintegrations_total": self.reintegrations_total.value,
             "swaps_total": self.swaps_total.value,
             "swap_rollbacks_total": self.swap_rollbacks_total.value,
+            "scale_downs_total": self.scale_downs_total.value,
+            "scale_ups_total": self.scale_ups_total.value,
             "queue_depth": self.queue_depth.value,
             "request_latency_ms": self.request_latency_ms.snapshot(),
             "queue_wait_ms": self.queue_wait_ms.snapshot(),

@@ -47,7 +47,18 @@ robustness discipline PR 7 built for training:
   to TOKEN units, and ejection re-packs the victim's queued + in-flight
   requests on the survivors' token queues.  Hedged duplicates always stay
   on the padded per-bucket path (both paths are warmed, so neither can
-  retrace post-warmup).
+  retrace post-warmup);
+- **a mutable tuning surface** (:meth:`apply_knob` + warm-standby scaling)
+  — the hand-set constants (``hedge_ms``, ``max_wait_ms``, the admission
+  thresholds) are thread-safe knobs with ONE setter, and a healthy replica
+  can be drained to a **warm standby** (:meth:`deactivate_replica`: its
+  queue moves to peers, its engine keeps its compiled caches and its
+  worker keeps beating) and brought back through the same warmup-gated
+  path a relaunch uses (:meth:`activate_replica`) — so the feedback
+  control plane (:mod:`pdnlp_tpu.serve.controller`) can actuate capacity
+  without ever introducing a post-warmup retrace.  Every controller write
+  must come through the controller's ``_actuate`` choke point (jaxlint
+  R13), which records a decision chain explaining the change.
 
 Single-replica serving is untouched: :class:`DynamicBatcher` remains the
 default path (``serve_tpu.py`` only builds a router under ``--replicas N``
@@ -88,8 +99,12 @@ class _Replica:
     States: ``warming`` (worker is pre-tracing every bucket; not
     dispatchable) -> ``healthy`` -> ``draining`` (rolling swap: finish
     in-flight, accept queue but execute nothing) -> back to ``healthy``;
-    ``ejected`` is terminal for THIS incarnation (a relaunch builds a new
-    one in the same slot)."""
+    ``standby`` (scaled down by the control plane: queues empty, engine
+    warm — compiled caches intact — worker parked but still beating;
+    :meth:`ReplicaRouter.activate_replica` sends it back through
+    ``warming``, which is all cache hits, so reactivation can never
+    retrace); ``ejected`` is terminal for THIS incarnation (a relaunch
+    builds a new one in the same slot)."""
 
     def __init__(self, index: int, engine, buckets: Sequence[int],
                  flush_rows: int, pack_width: int = 0):
@@ -335,9 +350,11 @@ class ReplicaRouter:
         with self._lock:
             while time.monotonic() < deadline:
                 reps = [s.replica for s in self._slots if s.replica]
-                if reps and all(r.state in ("healthy", "draining", "ejected")
+                if reps and all(r.state in ("healthy", "draining",
+                                            "standby", "ejected")
                                 for r in reps) \
-                        and any(r.state != "ejected" for r in reps):
+                        and any(r.state in ("healthy", "draining")
+                                for r in reps):
                     return True
                 self._cond.wait(timeout=0.05)
         return False
@@ -469,9 +486,14 @@ class ReplicaRouter:
                 raise QueueFullError("no replica available (all ejected?)")
             self._enqueue(slot, req)
             # ONE hop for admission + initial queue placement (the attrs
-            # carry the tier AND where the request landed)
+            # carry the tier AND where the request landed); tokens +
+            # deadline ride along so serve.replay can reconstruct the
+            # arrival process (timestamps, lengths, deadlines) from the
+            # recorded chains
             record_hop(self.tracer, req.rid, "admit", tier=tier,
-                       replica=slot.index,
+                       replica=slot.index, tokens=len(req.ids),
+                       **({} if deadline_ms is None
+                          else {"deadline_ms": float(deadline_ms)}),
                        **({"packed": True} if self.packed
                           else {"bucket": req.bucket}))
             self.metrics.requests_total.inc()
@@ -584,30 +606,41 @@ class ReplicaRouter:
                     mem = getattr(rep.engine, "beat_memory", None)
                     rep.hb.beat(step=rep.batches,
                                 **(mem() if mem is not None else {}))
+                rewarm = False
                 with self._lock:
                     if self._stop or rep.state == "ejected":
                         return
+                    # standby -> warming (activate_replica): leave the lock
+                    # and re-run the warmup probes — all compile-cache hits
+                    # on a warm engine, but the GATE is the same as a
+                    # relaunch's, so a cold engine could never slip through
+                    rewarm = rep.state == "warming"
                     batch = None
-                    if rep.state == "healthy":
+                    if not rewarm and rep.state == "healthy":
                         batch = self._take_flushable(rep)
-                    if batch is None:
-                        # a non-healthy replica (draining/warming) must
-                        # NOT derive its wakeup from overdue queue ticks —
-                        # _next_wakeup would return 0 and the worker would
-                        # busy-spin on the router lock for the whole drain
+                    if not rewarm and batch is None:
+                        # a non-healthy replica (draining/warming/standby)
+                        # must NOT derive its wakeup from overdue queue
+                        # ticks — _next_wakeup would return 0 and the
+                        # worker would busy-spin on the router lock
                         timeout = (self._next_wakeup(rep)
                                    if rep.state == "healthy" else None)
                         self._cond.wait(timeout=min(
                             self._beat_interval,
                             timeout if timeout is not None else 3600.0))
                         continue
-                    slot = self._slots[rep.index]
-                    if not isinstance(batch, _PackIntent):
-                        # a _PackIntent's requests stay QUEUED (visible to
-                        # eject/shed/expiry) until the pack is formed below
-                        rep.inflight = batch
-                        slot.metrics.inflight.set(len(rep.inflight))
-                    slot.metrics.queue_depth.set(rep.queued())
+                    if not rewarm:
+                        slot = self._slots[rep.index]
+                        if not isinstance(batch, _PackIntent):
+                            # a _PackIntent's requests stay QUEUED (visible
+                            # to eject/shed/expiry) until the pack is
+                            # formed below
+                            rep.inflight = batch
+                            slot.metrics.inflight.set(len(rep.inflight))
+                        slot.metrics.queue_depth.set(rep.queued())
+                if rewarm:
+                    self._warm(rep)
+                    continue
                 if isinstance(batch, _PackIntent):
                     # the expensive bin-pack runs OUTSIDE the pool lock
                     pb, _ = form_packed_batch(
@@ -615,10 +648,11 @@ class ReplicaRouter:
                         rep.flush_rows, self.pack_segments,
                         self._tokenizer.pad_id, self.max_wait_ms / 1e3)
                     with self._lock:
-                        if self._stop or rep.state == "ejected":
-                            # ejected mid-pack: every snapshot request was
-                            # requeued onto survivors (they were still
-                            # queued) — abandon the formed batch
+                        if self._stop or rep.state in ("ejected", "standby"):
+                            # ejected (or drained to standby) mid-pack:
+                            # every snapshot request was requeued onto
+                            # peers (they were still queued) — abandon the
+                            # formed batch
                             continue
                         # reconcile: take exactly the packed requests out
                         # of the queue; anything the monitor completed
@@ -1073,6 +1107,164 @@ class ReplicaRouter:
             self._checkpoint_path = path  # relaunches warm onto the new one
         return report
 
+    # ------------------------------------------------------- tuning surface
+    #: the knobs the feedback control plane may actuate — ONE setter
+    #: (:meth:`apply_knob`) so every write is thread-safe and every
+    #: controller-side write can be funneled through the decision-recording
+    #: ``_actuate`` choke point (jaxlint R13 flags any other path)
+    KNOBS = ("hedge_ms", "max_wait_ms", "backpressure_at", "shed_at",
+             "shed_slack_ms")
+
+    def apply_knob(self, name: str, value) -> None:
+        """Set one tunable serving knob, thread-safely, effective for the
+        next flush/scan (workers and the monitor read these under the
+        pool lock).  Admission thresholds are validated against the
+        ladder's ordering invariant — a controller bug must surface here,
+        not as an unreachable tier."""
+        with self._lock:
+            if name == "hedge_ms":
+                self.hedge_ms = None if value is None else float(value)
+            elif name == "max_wait_ms":
+                self.max_wait_ms = float(value)
+            elif name in ("backpressure_at", "shed_at"):
+                adm = self.admission
+                trial = {"backpressure_at": adm.backpressure_at,
+                         "shed_at": adm.shed_at, name: int(value)}
+                if not (0 <= trial["backpressure_at"] <= trial["shed_at"]
+                        <= adm.max_queue):
+                    raise ValueError(
+                        f"knob {name}={value} breaks tier ordering: "
+                        f"backpressure_at {trial['backpressure_at']} <= "
+                        f"shed_at {trial['shed_at']} <= max_queue "
+                        f"{adm.max_queue}")
+                setattr(adm, name, int(value))
+            elif name == "shed_slack_ms":
+                self.admission.shed_slack_ms = float(value)
+            else:
+                raise KeyError(f"unknown knob {name!r} (tunable: "
+                               f"{self.KNOBS})")
+            self._cond.notify_all()
+
+    def knob_values(self) -> Dict:
+        """Current values of every tunable knob (controller sense input +
+        the exporter's ``controller`` source)."""
+        return {"hedge_ms": self.hedge_ms,
+                "max_wait_ms": self.max_wait_ms,
+                "backpressure_at": self.admission.backpressure_at,
+                "shed_at": self.admission.shed_at,
+                "shed_slack_ms": self.admission.shed_slack_ms}
+
+    def deactivate_replica(self, index: Optional[int] = None) -> int:
+        """Drain one healthy replica to a WARM STANDBY (control-plane
+        scale-down): its queued requests move to peers within their
+        deadline budgets (graceful — no retry is charged), its worker
+        parks (still beating, so the monitor keeps seeing it alive), and
+        its engine keeps every compiled cache, so
+        :meth:`activate_replica`'s warmup-gated return is all cache hits —
+        zero post-warmup retraces by construction.  ``index=None`` picks
+        the least-loaded healthy replica.  Refuses to drain the last
+        dispatchable replica.  Returns the drained slot index."""
+        with self._lock:
+            healthy = [s for s in self._slots if s.replica is not None
+                       and s.replica.state == "healthy"
+                       and s.replica.exit_code is None]
+            dispatchable = [s for s in self._slots if s.replica is not None
+                            and s.replica.state in ("healthy", "draining")
+                            and s.replica.exit_code is None]
+            if index is None:
+                cands = sorted(healthy, key=lambda s: s.replica.load())
+                if not cands:
+                    raise RuntimeError("no healthy replica to deactivate")
+                slot = cands[0]
+            else:
+                slot = self._slots[index]
+                if slot.replica is None \
+                        or slot.replica.state != "healthy":
+                    raise RuntimeError(
+                        f"replica {index} is "
+                        f"{slot.replica.state if slot.replica else 'empty'}"
+                        ", not healthy")
+            if len(dispatchable) <= 1:
+                raise RuntimeError("refusing to drain the last "
+                                   "dispatchable replica")
+            rep = slot.replica
+            rep.state = "standby"
+            self.metrics.scale_downs_total.inc()
+            # queued work moves to peers NOW (the standby executes
+            # nothing); in-flight work finishes on this worker first —
+            # the state flip only stops NEW dispatch
+            queued = [r for q in rep.all_queues() for r in q]
+            for q in rep.all_queues():
+                q.clear()
+            slot.metrics.queue_depth.set(0)
+            now = self.clock()
+            for r in queued:
+                if r.done():
+                    continue
+                if r.deadline is not None and now >= r.deadline:
+                    self._finish_locked(r, error=DeadlineExceeded(
+                        "deadline passed while queued"))
+                    continue
+                target = self._pick_slot(exclude=slot.index)
+                if target is None:  # cannot happen (dispatchable > 1),
+                    rep.state = "healthy"  # but never strand work on a bug
+                    raise RuntimeError("no peer to absorb the drained "
+                                       "queue")
+                self.metrics.requeued_total.inc()
+                slot.metrics.requeued_out.inc()
+                target.metrics.requeued_in.inc()
+                record_hop(self.tracer, r.rid, "requeue",
+                           from_replica=slot.index,
+                           to_replica=target.index, standby=True,
+                           inflight=False, packed=self.packed)
+                if self.packed:
+                    target.replica.pack_queue.append(r)
+                else:
+                    target.replica.queues[r.bucket].append(r)
+                target.metrics.queue_depth.set(target.replica.queued())
+            self._cond.notify_all()
+            return slot.index
+
+    def activate_replica(self, index: Optional[int] = None) -> int:
+        """Bring a warm standby back into dispatch through the SAME
+        warmup gate a relaunch uses: standby -> warming (the worker
+        re-runs every bucket probe — compile-cache hits on the warm
+        engine) -> healthy.  If the pool's checkpoint advanced while the
+        replica was parked (rolling swap), the warmup reloads it first.
+        ``index=None`` picks the first standby.  Returns the slot index."""
+        with self._lock:
+            if index is None:
+                standbys = [s for s in self._slots if s.replica is not None
+                            and s.replica.state == "standby"]
+                if not standbys:
+                    raise RuntimeError("no standby replica to activate")
+                slot = standbys[0]
+            else:
+                slot = self._slots[index]
+                if slot.replica is None \
+                        or slot.replica.state != "standby":
+                    raise RuntimeError(
+                        f"replica {index} is "
+                        f"{slot.replica.state if slot.replica else 'empty'}"
+                        ", not standby")
+            slot.replica.state = "warming"
+            self.metrics.scale_ups_total.inc()
+            self._cond.notify_all()
+            return slot.index
+
+    @property
+    def active_count(self) -> int:
+        """Replicas currently dispatchable or becoming so (healthy /
+        draining / warming) — the control plane's capacity signal."""
+        return sum(1 for s in self._slots if s.replica is not None
+                   and s.replica.state in ("healthy", "draining", "warming")
+                   and s.replica.exit_code is None)
+
+    @property
+    def standby_count(self) -> int:
+        return sum(1 for s in self._slots if s.replica is not None
+                   and s.replica.state == "standby")
+
     # ----------------------------------------------------------- reporting
     def flush_telemetry(self, event: str = "") -> None:
         """Spans + a full metrics snapshot to disk (``telemetry_dir``),
@@ -1091,6 +1283,32 @@ class ReplicaRouter:
                                     "router_snapshot.json"))
         except OSError:
             pass
+
+    def control_snapshot(self) -> Dict:
+        """The control plane's per-tick sense input: counters, gauges,
+        knobs and ONE latency percentile — none of the per-replica
+        histogram-window copies :meth:`snapshot` pays, so a sub-second
+        control interval never steals meaningful time from the serving
+        workers it exists to help."""
+        m = self.metrics
+        return {
+            "router": {
+                "requests_total": m.requests_total.value,
+                "deadline_expired_total": m.deadline_expired_total.value,
+                "queue_depth": m.queue_depth.value,
+                "admission": {
+                    "backpressure_waits":
+                        m.backpressure_waits_total.value,
+                    "shed": m.shed_total.value,
+                    "rejected": m.rejected_total.value,
+                },
+                "request_latency_ms":
+                    {"p99": m.request_latency_ms.percentile(99)},
+            },
+            "knobs": self.knob_values(),
+            "active": self.active_count,
+            "standby": self.standby_count,
+        }
 
     def engine(self, index: int = 0):
         """The live engine in slot ``index`` (current incarnation)."""
@@ -1123,6 +1341,9 @@ class ReplicaRouter:
 
         return {
             "router": self.metrics.snapshot(),
+            "knobs": self.knob_values(),
+            "active": self.active_count,
+            "standby": self.standby_count,
             "replicas": {
                 str(s.index): {
                     "state": s.replica.state if s.replica else "empty",

@@ -48,12 +48,21 @@ additionally records spans AND per-request hop chains (every request's
 admission → queue → dispatch → completion life is reconstructable by
 ``trace_tpu.py request <id>``).
 
+``--controller on`` (with ``--replicas N``) attaches the feedback control
+plane (:class:`~pdnlp_tpu.serve.controller.ServeController`): replica
+count (warm-standby scaling, never below ``--min_replicas``),
+``hedge_ms``, flush age and admission thresholds track the live telemetry
+through a decision-recording, auto-reverting actuation path — controller
+state rides ``/metrics`` and is summarized in ``/healthz``, and every
+knob turn is reconstructable via ``trace_tpu.py decisions``.
+
 Serve-local flags (not ``Args`` fields): ``--checkpoint`` (default: newest
 under ``--output_dir``), ``--buckets 32,64,128``, ``--max_batch_size``,
 ``--max_wait_ms``, ``--max_queue``, ``--deadline_ms``, ``--replicas``,
-``--hedge_ms``, ``--replica_stall_s``, ``--serve_pack``, ``--input``,
-``--output``, ``--metrics_path``, ``--no_mesh``.  Everything else (model,
-dtype, vocab, output_dir, ...) is the standard ``Args`` CLI.
+``--hedge_ms``, ``--replica_stall_s``, ``--serve_pack``, ``--controller``,
+``--min_replicas``, ``--input``, ``--output``, ``--metrics_path``,
+``--no_mesh``.  Everything else (model, dtype, vocab, output_dir, ...) is
+the standard ``Args`` CLI.
 """
 from __future__ import annotations
 
@@ -187,6 +196,8 @@ def main(argv=None) -> None:
     argv, hedge_ms = pop_cli_flag(argv, "--hedge_ms", None, float)
     argv, stall_s = pop_cli_flag(argv, "--replica_stall_s", 10.0, float)
     argv, serve_pack = pop_cli_flag(argv, "--serve_pack", "auto")
+    argv, controller_mode = pop_cli_flag(argv, "--controller", "off")
+    argv, min_replicas = pop_cli_flag(argv, "--min_replicas", 1, int)
     argv, in_path = pop_cli_flag(argv, "--input")
     argv, out_path = pop_cli_flag(argv, "--output")
     argv, metrics_path = pop_cli_flag(argv, "--metrics_path")
@@ -213,6 +224,23 @@ def main(argv=None) -> None:
         engine = build_engine(args, checkpoint=checkpoint,
                               use_mesh=not no_mesh)
 
+    # the feedback control plane rides the multi-replica router only (the
+    # knobs it actuates — replica count, hedge, admission tiers — only
+    # exist there); it starts AFTER warmup below so its first sense window
+    # never reads compile time as serving latency
+    controller = None
+    if controller_mode not in ("off", "false", "0", None):
+        if router is None:
+            rank0_print("WARNING: --controller needs --replicas N > 1 "
+                        "(online mode) — running without a control plane",
+                        file=sys.stderr)
+        else:
+            from pdnlp_tpu.serve.controller import ServeController
+
+            controller = ServeController(router,
+                                         min_replicas=min_replicas,
+                                         tracer=engine.tracer)
+
     # live telemetry (--metrics_port / --flight_recorder): Prometheus
     # /metrics + JSON /healthz off the hot path, plus the bounded
     # flight-recorder JSONL so a SIGKILL'd server still leaves evidence
@@ -226,7 +254,15 @@ def main(argv=None) -> None:
                          "memory": engine.memory_snapshot})
         if router is not None:
             sources["memory"] = memory_snapshot
-        exporter = build_from_args(args, sources, "flight_serve.jsonl")
+        health = None
+        if controller is not None:
+            # controller state on BOTH surfaces: full knob/hold/revert
+            # detail as a /metrics source, the at-a-glance summary on
+            # /healthz (the probe a load balancer reads)
+            sources["controller"] = controller.snapshot
+            health = {"controller": controller.health_summary}
+        exporter = build_from_args(args, sources, "flight_serve.jsonl",
+                                   health_sources=health)
         if exporter is not None and exporter.port is not None:
             rank0_print(f"[obs] /metrics + /healthz on "
                         f"http://127.0.0.1:{exporter.port}",
@@ -283,6 +319,12 @@ def main(argv=None) -> None:
             sys.exit("serve_tpu: no replica finished warmup — the pool is "
                      "dead (corrupt checkpoint? every worker's warm load "
                      "failed?); refusing to serve nothing")
+        if controller is not None:
+            controller.start()
+            rank0_print("[controller] feedback control plane on "
+                        f"(min_replicas={min_replicas}; decisions land in "
+                        "the trace — trace_tpu.py decisions)",
+                        file=sys.stderr)
     else:
         frontend = DynamicBatcher(
             engine, buckets=buckets, max_batch_size=max_batch,
@@ -352,9 +394,13 @@ def main(argv=None) -> None:
         rank0_print(f"[serve] {e} — draining {len(inflight)} in-flight "
                     "request(s), then shutting down", file=sys.stderr)
     finally:
-        # graceful shutdown: every accepted request is completed or
+        # graceful shutdown: the controller stops actuating FIRST (and
+        # resolves its pending decision evaluations so the flushed trace
+        # validates), then every accepted request is completed or
         # deadline-failed through emit() — never silently dropped — then
         # the frontend drains its queues and telemetry hits disk
+        if controller is not None:
+            controller.stop()
         while inflight:
             emit(inflight.popleft())
         frontend.stop(drain=True)

@@ -354,23 +354,54 @@ def _spawn(out, extra, env_extra, port, data_path=None, timeout=900):
         env.pop(k, None)
     env.update(env_extra)
     data = ["--data_path", str(data_path)] if data_path else []
-    return subprocess.run(
+    # poll-with-deadline instead of subprocess.run's raise-on-timeout: a
+    # loaded host that blows the (generous) deadline must yield the
+    # partial stdout/stderr so the caller's skip classifier can see WHY,
+    # not error the whole module's fixtures with TimeoutExpired
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "multi-tpu-spawn-cls.py"),
          "--num_processes", "2", "--output_dir", str(out), *COMMON, *data,
          *extra],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        # kill the whole session, not just the supervisor: the spawned
+        # rank subprocesses would otherwise outlive it holding the
+        # coordination port — poisoning the next fixture on that port
+        import signal as _signal
+
+        try:
+            os.killpg(os.getpgid(proc.pid), _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        stdout, stderr = proc.communicate(timeout=30)
+        rc = proc.returncode if proc.returncode is not None else -9
+        stderr += f"\n[test] deadline ({timeout}s) exceeded — killed\n"
+    return subprocess.CompletedProcess(proc.args, rc, stdout, stderr)
 
 
 @pytest.fixture(scope="module")
 def chaos_shrink_run(tmp_path_factory, corpus_path):
     """SIGKILL rank 1 mid-epoch; the supervisor must evict it and finish
-    the run at width 1 (degrade, don't die)."""
+    the run at width 1 (degrade, don't die).
+
+    Load tolerance (the PR-10 flake): the fault trigger is STEP-count
+    based, but stall detection is wall-clock — a loaded host whose XLA
+    compile outruns a tight ``stall_timeout`` would read as a whole-gang
+    stall and restart at full width, derailing the evict-and-shrink
+    scenario.  The timeout here is deliberately generous (SIGKILL
+    detection rides the exit code, not the stall clock, so a big value
+    costs nothing on the pass path), and ``_spawn`` polls with a deadline
+    instead of raising."""
     out = tmp_path_factory.mktemp("chaos_shrink")
     proc = _spawn(out, ["--elastic", "true", "--resume_every", "2",
-                        "--stall_timeout", "60"],
+                        "--stall_timeout", "300"],
                   {"PDNLP_FAULT_STEP": "5", "PDNLP_FAULT_PROC": "1",
                    "PDNLP_FAULT_KIND": "sigkill"}, port=12411,
-                  data_path=corpus_path)
+                  data_path=corpus_path, timeout=1200)
     return proc, out
 
 
@@ -381,13 +412,21 @@ def _skip_if_multiproc_unsupported(proc):
     suite here.  Skip rather than mis-assert: the single-process-gang
     chaos variant below and the in-process elastic-width test carry the
     coverage on such images; this test runs fully where multi-process
-    collectives exist (real pods, newer jax)."""
-    if proc.returncode != 0 and \
-            "Multiprocess computations aren't implemented" in proc.stderr:
+    collectives exist (real pods, newer jax).
+
+    The message is checked REGARDLESS of exit code (the PR-10 skip->fail
+    flake): under host load the two init-crashed ranks can be detected on
+    DIFFERENT supervisor polls, so the first verdict names only one dead
+    rank, the gang "shrinks" to width 1 — which this jax CAN run — and
+    the run completes rc=0 as a fresh width-1 start.  That is still the
+    unsupported-backend case (the 2-proc scenario under test never
+    happened), and the stderr still carries the workers' message."""
+    if "Multiprocess computations aren't implemented" in proc.stderr:
         pytest.skip("backend cannot run multi-process CPU gangs "
                     "(pre-existing spawn-suite incompatibility)")
 
 
+@pytest.mark.slow
 def test_chaos_sigkill_evicts_and_resumes_at_reduced_width(chaos_shrink_run):
     proc, out = chaos_shrink_run
     _skip_if_multiproc_unsupported(proc)

@@ -1,0 +1,506 @@
+"""Control-plane tests: the sense->decide->actuate->evaluate->revert loop
+on an injected clock and a fake router (no sleeps, no threads), the real
+router's warm-standby scale cycle, decision-chain integrity through the
+``trace_tpu.py decisions`` CLI, and replay-schedule determinism."""
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pdnlp_tpu.obs.decision import (  # noqa: E402
+    decision_chains, decision_issues, validate_decisions,
+)
+from pdnlp_tpu.obs.trace import Tracer  # noqa: E402
+from pdnlp_tpu.serve.controller import ServeController  # noqa: E402
+
+from tests.test_elastic import FakeClock  # noqa: E402
+from tests.test_router import FakeEngine, _router  # noqa: E402
+
+
+class FakeRouter:
+    """Router-shaped test double exposing exactly the tuning surface the
+    controller consumes: snapshot counters/gauges the test scripts, and
+    recorded actuations."""
+
+    def __init__(self, active=3, standby=0):
+        self.counters = {"requests": 0, "deadline": 0, "shed": 0,
+                         "rejected": 0, "backpressure": 0}
+        self.p99 = None
+        self.active = active
+        self.standby = standby
+        self.queue_depth = 0.0
+        self.max_batch_size = 8
+        self.knobs = {"hedge_ms": 100.0, "max_wait_ms": 5.0,
+                      "backpressure_at": 32, "shed_at": 48,
+                      "shed_slack_ms": 10.0}
+        self.applied = []
+        self.tracer = Tracer(enabled=True)
+
+    # --- the tuning surface ---
+    def knob_values(self):
+        return dict(self.knobs)
+
+    def apply_knob(self, name, value):
+        if name not in self.knobs:
+            raise KeyError(name)
+        self.knobs[name] = value
+        self.applied.append((name, value))
+
+    def deactivate_replica(self, index=None):
+        if self.active <= 1:
+            raise RuntimeError("last dispatchable replica")
+        self.active -= 1
+        self.standby += 1
+        self.applied.append(("scale_down", self.active))
+        return 0
+
+    def activate_replica(self, index=None):
+        if self.standby <= 0:
+            raise RuntimeError("no standby")
+        self.active += 1
+        self.standby -= 1
+        self.applied.append(("scale_up", self.active))
+        return 0
+
+    @property
+    def active_count(self):
+        return self.active
+
+    @property
+    def standby_count(self):
+        return self.standby
+
+    def snapshot(self):
+        c = self.counters
+        return {
+            "router": {
+                "requests_total": c["requests"],
+                "deadline_expired_total": c["deadline"],
+                "queue_depth": self.queue_depth,
+                "admission": {"backpressure_waits": c["backpressure"],
+                              "shed": c["shed"],
+                              "rejected": c["rejected"]},
+                "request_latency_ms": {"p99": self.p99},
+            },
+            "active": self.active,
+            "standby": self.standby,
+            "knobs": self.knob_values(),
+        }
+
+
+def _controller(router=None, clk=None, **kw):
+    router = router or FakeRouter()
+    clk = clk or FakeClock()
+    kw.setdefault("eval_window_s", 5.0)
+    kw.setdefault("hold_base_s", 30.0)
+    kw.setdefault("revert_margin", 0.2)
+    kw.setdefault("scale_patience", 3)
+    c = ServeController(router, clock=clk, tracer=router.tracer, **kw)
+    assert c.step() is None  # first tick only primes the counter deltas
+    clk.advance(1.0)
+    return c, router, clk
+
+
+def _tick(c, clk, dt=1.0):
+    s = c.step()
+    clk.advance(dt)
+    return s
+
+
+#: neutralizes the scaling law in knob-focused tests (an idle fake pool
+#: would otherwise legitimately scale itself down mid-test)
+NO_SCALE = {"scale_patience": 10 ** 6}
+
+
+# ------------------------------------------------------------- hysteresis
+def test_hysteresis_prevents_flapping():
+    c, r, clk = _controller(**NO_SCALE)
+    r.p99 = 51.0  # target hedge = 102ms vs current 100ms: inside the band
+    _tick(c, clk)
+    assert [a for a in r.applied if a[0] == "hedge_ms"] == []
+    r.p99 = 100.0  # target 200ms: 100% change, outside the band
+    _tick(c, clk)
+    assert ("hedge_ms", 200.0) in r.applied
+    # and the setpoint wobbling around 200 does NOT re-actuate
+    applied_before = len(r.applied)
+    for p99 in (95.0, 108.0, 99.0, 104.0):
+        clk.advance(60.0)  # cooldown long expired — only the band holds
+        r.p99 = p99
+        _tick(c, clk)
+    assert len(r.applied) == applied_before
+
+
+# ---------------------------------------------------------------- cooldown
+def test_cooldown_respected():
+    c, r, clk = _controller(**NO_SCALE)
+    r.p99 = 100.0
+    _tick(c, clk)
+    assert ("hedge_ms", 200.0) in r.applied
+    # p99 IMPROVED enough to want a lower hedge (outside the band, inside
+    # the revert margin) — but the knob's cooldown has not passed
+    r.p99 = 60.0
+    _tick(c, clk)
+    assert ("hedge_ms", 120.0) not in r.applied
+    assert c.blocked_total >= 1
+    clk.advance(10.0)
+    _tick(c, clk)
+    assert ("hedge_ms", 120.0) in r.applied
+
+
+# ------------------------------------------------------------------- clamp
+def test_clamp_bounds_hold():
+    c, r, clk = _controller(**NO_SCALE)
+    spec = c.specs["max_wait_ms"]
+    assert c.inject("max_wait_ms", 10_000.0)  # way past the safe range
+    assert r.knobs["max_wait_ms"] == spec.hi
+    clk.advance(1.0)
+    assert c.inject("max_wait_ms", -5.0)
+    assert r.knobs["max_wait_ms"] == spec.lo
+    # replicas clamp to the floor: a scale-down below min_replicas is a
+    # refused no-op, not an actuation
+    c2, r2, clk2 = _controller(FakeRouter(active=1))
+    assert not c2.inject("replicas", 0)
+    assert r2.active == 1
+
+
+# -------------------------------------------------------- evaluate / revert
+def test_bad_actuation_auto_reverts_and_enters_backoff_hold():
+    # manage_hedge off: the injected actuation is the ONLY writer, so the
+    # revert target is unambiguous
+    c, r, clk = _controller(manage_hedge=False, **NO_SCALE)
+    r.p99 = 100.0
+    _tick(c, clk)  # sense a healthy baseline
+    assert c.inject("hedge_ms", 900.0)
+    assert r.knobs["hedge_ms"] == 900.0
+    r.p99 = 500.0  # the change regressed its own signal
+    clk.advance(c.eval_window_s + 1.0)
+    _tick(c, clk)
+    # reverted to the pre-actuation value, decision recorded
+    assert r.knobs["hedge_ms"] == 100.0
+    assert c.reverts_total == 1
+    assert c._strikes["hedge_ms"] == 1
+    # the knob is HELD: a law-path (non-forced) actuation is refused for
+    # the whole backoff window
+    blocked0 = c.blocked_total
+    assert not c._actuate("hedge_ms", 400.0, {"note": "law"})
+    assert c.blocked_total == blocked0 + 1
+    assert r.knobs["hedge_ms"] == 100.0
+    assert "hedge_ms" in c.snapshot()["holds_s"]
+    # the revert's own evaluation never revert-the-reverts
+    clk.advance(c.eval_window_s + 1.0)
+    _tick(c, clk)
+    assert r.knobs["hedge_ms"] == 100.0
+    assert c.reverts_total == 1
+    # a second strike doubles the hold (capped)
+    clk.advance(c.hold_base_s + 1.0)
+    assert c.inject("hedge_ms", 900.0)
+    r.p99 = 700.0
+    clk.advance(c.eval_window_s + 1.0)
+    _tick(c, clk)
+    assert c._strikes["hedge_ms"] == 2
+    hold = c.snapshot()["holds_s"]["hedge_ms"]
+    assert c.hold_base_s < hold <= 2 * c.hold_base_s
+
+
+def test_revert_restores_a_none_valued_knob():
+    """Regression (review finding): hedging enabled by an actuation on a
+    hedge-off router must be revertable BACK to None — clamp(None) used
+    to raise, leaving the harmful value in place while the trace claimed
+    the revert happened."""
+    r = FakeRouter()
+    r.knobs["hedge_ms"] = None
+    c, r, clk = _controller(router=r, manage_hedge=False, **NO_SCALE)
+    r.p99 = 100.0
+    _tick(c, clk)
+    assert c.inject("hedge_ms", 500.0)
+    assert r.knobs["hedge_ms"] == 500.0
+    r.p99 = 400.0  # regressed: the revert must restore hedging OFF
+    clk.advance(c.eval_window_s + 1.0)
+    _tick(c, clk)
+    assert r.knobs["hedge_ms"] is None
+    assert c.reverts_total == 1 and c.errors_total == 0
+    from pdnlp_tpu.obs.decision import validate_decisions
+
+    c.stop()
+    assert not validate_decisions(r.tracer.records())["incomplete"]
+
+
+def test_scale_up_is_never_auto_reverted():
+    """Review finding: a still-building burst keeps worsening the signal
+    AFTER capacity was added — attributing that to the scale-up and
+    draining the new replica mid-overload would be the control plane
+    hurting exactly when it must help.  Scale-DOWNS stay revertable."""
+    c, r, clk = _controller(FakeRouter(active=2, standby=1),
+                            manage_hedge=False, **NO_SCALE)
+    r.p99 = 50.0
+    _tick(c, clk)
+    assert c.inject("replicas", 3)
+    assert r.active == 3
+    r.p99 = 500.0  # the burst keeps building past the eval window
+    clk.advance(c.eval_window_s + 1.0)
+    _tick(c, clk)
+    assert r.active == 3  # capacity kept
+    assert c.reverts_total == 0
+    # the symmetric direction still reverts: a bad scale-DOWN comes back
+    clk.advance(c.specs["replicas"].cooldown_s + 1.0)
+    assert c.inject("replicas", 2)
+    assert r.active == 2
+    r.p99 = 2000.0
+    clk.advance(c.eval_window_s + 1.0)
+    _tick(c, clk)
+    assert r.active == 3 and c.reverts_total == 1
+
+
+def test_kept_outcome_resets_strikes():
+    c, r, clk = _controller(**NO_SCALE)
+    r.p99 = 100.0
+    _tick(c, clk)
+    c._strikes["hedge_ms"] = 1  # as if a past revert happened
+    assert c.inject("hedge_ms", 250.0)
+    r.p99 = 90.0  # improved: the change is kept
+    clk.advance(c.eval_window_s + 1.0)
+    _tick(c, clk)
+    assert r.knobs["hedge_ms"] == 250.0
+    assert c.reverts_total == 0
+    assert c._strikes["hedge_ms"] == 0
+
+
+# ------------------------------------------------------------- scaling law
+def test_scale_down_needs_patience_then_reactivates_on_load():
+    c, r, clk = _controller(scale_patience=3, util_low=0.2, util_high=0.7)
+    # idle pool: util 0 — but scale-down only after 3 consecutive ticks
+    for i in range(2):
+        _tick(c, clk)
+        assert not any(a[0] == "scale_down" for a in r.applied), i
+    _tick(c, clk)
+    assert ("scale_down", 2) in r.applied
+    assert r.standby == 1
+    # rising load: queue depth past the high-water mark brings it back
+    r.queue_depth = 3 * 2 * r.max_batch_size  # util >> util_high
+    clk.advance(c.specs["replicas"].cooldown_s)
+    for _ in range(4):  # EWMA needs a couple of ticks to cross the band
+        _tick(c, clk)
+        if ("scale_up", 3) in r.applied:
+            break
+    assert ("scale_up", 3) in r.applied
+    assert r.standby == 0
+
+
+def test_scale_down_never_below_floor():
+    c, r, clk = _controller(min_replicas=2, scale_patience=1)
+    for _ in range(6):
+        clk.advance(c.specs["replicas"].cooldown_s)
+        _tick(c, clk)
+    assert r.active == 2  # one scale-down, then the floor binds
+    assert r.applied.count(("scale_down", 2)) == 1
+
+
+# ------------------------------------------- real router: standby cycle
+def test_router_standby_cycle_requeues_and_rewarms():
+    clk = FakeClock()
+    r, engines = _router(n=2, start=False, clock=clk, max_batch_size=100,
+                         max_wait_ms=60_000.0)
+    r._started = True  # white-box: queue mechanics, no workers
+    for s in r._slots:
+        s.replica.state = "healthy"
+    req = r.submit_ids([2, 3], deadline_ms=60_000)
+    rep = next(s.replica for s in r._slots
+               if any(req in q for q in s.replica.queues.values()))
+    idx = rep.index
+    other = r._slots[1 - idx].replica
+    # index=None picks the LEAST-loaded healthy replica — the idle peer
+    assert r.deactivate_replica() == 1 - idx
+    r.activate_replica(1 - idx)
+    other.state = "healthy"  # white-box: no worker to run the re-warm
+    # draining the LOADED one moves its queued request to the peer
+    assert r.deactivate_replica(idx) == idx
+    assert any(req in q for q in other.queues.values())
+    assert req.retries == 0  # a drain is not a failure: no retry charged
+    assert rep.state == "standby"
+    assert r.metrics.scale_downs_total.value == 2
+    assert r.metrics.requeued_total.value == 1
+    # per-replica requeue accounting reconciles with the pool counter
+    assert r._slots[idx].metrics.requeued_out.value == 1
+    assert r._slots[1 - idx].metrics.requeued_in.value == 1
+    # standby replicas are not dispatch targets
+    req2 = r.submit_ids([2, 3], deadline_ms=60_000)
+    assert any(req2 in q for q in other.queues.values())
+    # the last dispatchable replica refuses to drain
+    with pytest.raises(RuntimeError, match="last dispatchable"):
+        r.deactivate_replica()
+    r.activate_replica(idx)
+    assert rep.state == "warming"
+    assert r.metrics.scale_ups_total.value == 2
+
+
+def test_router_standby_reactivation_is_warmup_gated_zero_retraces():
+    """Full-thread cycle: drain -> standby (worker parked, beating) ->
+    activate -> the worker re-runs every bucket probe BEFORE dispatch —
+    and the warm engine re-warms from cache, so the pool's post-warmup
+    retrace count stays zero through the whole cycle."""
+    r, engines = _router(n=2)
+    try:
+        idx = r.deactivate_replica()
+        probes_before = len(engines[idx].calls)
+        assert r.states[idx] == "standby"
+        assert r.active_count == 1 and r.standby_count == 1
+        # the reduced pool still serves
+        assert r.submit_ids([2, 3], deadline_ms=10_000)\
+                .result(timeout=10) is not None
+        r.activate_replica(idx)
+        assert r.wait_ready(10)
+        assert r.states[idx] == "healthy"
+        # warmup probes re-ran on the worker before it turned healthy
+        probes = engines[idx].calls[probes_before:]
+        assert [p for p in probes if p[0] == 1][: len(r.buckets)] == \
+            [(1, b) for b in r.buckets]
+        assert r.retraces_post_warmup == 0
+        assert r.submit_ids([2, 3], deadline_ms=10_000)\
+                .result(timeout=10) is not None
+        # scale events are NOT ejections/reintegrations
+        assert r.metrics.ejections_total.value == 0
+        assert r.metrics.reintegrations_total.value == 0
+    finally:
+        r.stop(drain=False)
+
+
+def test_apply_knob_validates_tier_ordering():
+    r, _ = _router(n=2, start=False)
+    assert r.knob_values()["max_wait_ms"] == 2.0
+    r.apply_knob("max_wait_ms", 9.0)
+    assert r.knob_values()["max_wait_ms"] == 9.0
+    with pytest.raises(ValueError, match="tier ordering"):
+        r.apply_knob("backpressure_at", r.admission.max_queue + 1)
+    with pytest.raises(KeyError):
+        r.apply_knob("poll_interval", 1.0)
+
+
+# -------------------------------------------------------- decision chains
+def test_decision_chains_validate_and_cli_roundtrip(tmp_path):
+    c, r, clk = _controller()
+    r.p99 = 100.0
+    _tick(c, clk)
+    assert c.inject("hedge_ms", 900.0)
+    r.p99 = 500.0
+    clk.advance(c.eval_window_s + 1.0)
+    _tick(c, clk)   # revert fires -> revert action opens its own eval
+    c.stop()        # pending evals resolved (outcome "shutdown")
+    records = r.tracer.records()
+    report = validate_decisions(records)
+    assert report["checked"] >= 2 and not report["incomplete"]
+    assert report["reverted"] >= 1
+    # every chain: action first, outcome last
+    for chain in decision_chains(records).values():
+        assert decision_issues(chain) == []
+    # the CLI round trip (file -> decisions subcommand)
+    path = tmp_path / "trace_proc0.jsonl"
+    from pdnlp_tpu.obs.export import write_jsonl
+
+    write_jsonl(records, str(path))
+    import trace_tpu
+
+    assert trace_tpu.main(["decisions", str(path)]) == 0
+    # a malformed chain (action without outcome) exits 1
+    stripped = [rec for rec in records
+                if (rec.get("attrs") or {}).get("phase") != "outcome"]
+    bad = tmp_path / "bad.jsonl"
+    write_jsonl(stripped, str(bad))
+    assert trace_tpu.main(["decisions", str(bad)]) == 1
+
+
+def test_controller_stop_resolves_pending_evaluations():
+    c, r, clk = _controller(manage_hedge=False, **NO_SCALE)
+    r.p99 = 100.0
+    _tick(c, clk)
+    assert c.inject("max_wait_ms", 40.0)
+    assert c.snapshot()["pending_evals"] == 1
+    c.stop()
+    assert c.snapshot()["pending_evals"] == 0
+    report = validate_decisions(r.tracer.records())
+    assert not report["incomplete"]
+
+
+# ------------------------------------------------------------- exporter
+def test_controller_state_on_metrics_and_healthz():
+    """Satellite wiring: controller state is a /metrics source and its
+    compact summary rides /healthz (health_sources) — and a raising
+    summary reports itself instead of killing the probe."""
+    import json as _json
+    import urllib.request
+
+    from pdnlp_tpu.obs.exporter import MetricsExporter
+
+    c, r, clk = _controller(manage_hedge=False, **NO_SCALE)
+    r.p99 = 100.0
+    _tick(c, clk)
+    assert c.inject("max_wait_ms", 40.0)
+    exp = MetricsExporter({"controller": c.snapshot}, port=0,
+                          health_sources={"controller": c.health_summary})
+    exp.start()
+    try:
+        base = f"http://127.0.0.1:{exp.port}"
+        body = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "pdnlp_controller_actuations_total 1" in body
+        assert "pdnlp_controller_knobs_max_wait_ms 40" in body
+        health = _json.loads(
+            urllib.request.urlopen(base + "/healthz").read().decode())
+        assert health["controller"]["actuations"] == 1
+        assert health["controller"]["active"] == 3
+        assert "held_knobs" in health["controller"]
+        # one sick summary must not blind the probe
+        exp.health_sources["boom"] = lambda: 1 / 0
+        health = _json.loads(
+            urllib.request.urlopen(base + "/healthz").read().decode())
+        assert health["status"] == "ok"
+        assert "ZeroDivisionError" in health["boom"]["error"]
+    finally:
+        exp.stop()
+
+
+# ------------------------------------------------------------------ replay
+def test_replay_same_seed_and_trace_identical_schedule():
+    from pdnlp_tpu.serve.replay import (
+        arrivals_from_trace, ids_for, shape_arrivals, synth_arrivals,
+    )
+
+    a = synth_arrivals(200, 150.0, seed=11)
+    b = synth_arrivals(200, 150.0, seed=11)
+    assert [x.as_tuple() for x in a] == [x.as_tuple() for x in b]
+    assert [x.as_tuple() for x in synth_arrivals(200, 150.0, seed=12)] \
+        != [x.as_tuple() for x in a]
+    for shape in ("steady", "diurnal", "flash"):
+        s1 = shape_arrivals(a, shape, speed=5.0)
+        s2 = shape_arrivals(b, shape, speed=5.0)
+        assert [x.as_tuple() for x in s1] == [x.as_tuple() for x in s2]
+        assert len(s1) == len(a)
+        # lengths/deadlines survive the warp untouched; time compresses
+        assert [x.tokens for x in s1] == [x.tokens for x in a]
+        assert s1[-1].t < a[-1].t
+    # a flash crowd compresses the burst window harder than steady
+    steady = shape_arrivals(a, "steady", speed=5.0)
+    flash = shape_arrivals(a, "flash", speed=5.0)
+    assert flash[-1].t < steady[-1].t
+    # ids are deterministic per arrival index
+    assert ids_for(a[3], 3) == ids_for(b[3], 3)
+    assert len(ids_for(a[3], 3)) == a[3].tokens
+
+    # trace -> schedule round trip is itself deterministic: the recorded
+    # admit hops ARE the schedule
+    tr = Tracer(enabled=True)
+    r, _ = _router(n=2, tracer=tr)
+    try:
+        futs = [r.submit_ids([2] * k, deadline_ms=4000) for k in (4, 9, 6)]
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        r.stop(drain=False)
+    got1 = arrivals_from_trace(tr.records())
+    got2 = arrivals_from_trace(tr.records())
+    assert [x.as_tuple() for x in got1] == [x.as_tuple() for x in got2]
+    assert [x.tokens for x in got1] == [4, 9, 6]
+    assert all(x.deadline_ms == 4000.0 for x in got1)
+    assert got1[0].t == 0.0

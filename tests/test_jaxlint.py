@@ -258,6 +258,38 @@ def test_r12_hint_names_the_barrier():
     assert "device_get" in f.hint and "block" in f.hint
 
 
+def test_r13_unrecorded_actuation_positive():
+    # direct knob write (7), raw apply_knob (11), nested admission
+    # threshold write (15), raw scale call (19), augmented write (23) —
+    # each outside _actuate in a controller-scope module
+    assert all_hits("r13_pos.py") == [("R13", 7), ("R13", 11),
+                                      ("R13", 15), ("R13", 19),
+                                      ("R13", 23)]
+
+
+def test_r13_unrecorded_actuation_negative():
+    assert hits("r13_neg.py", "R13") == []
+
+
+def test_r13_requires_controller_context(tmp_path):
+    """The router/batcher own their knobs until a controller is in play:
+    a module that never imports the controller (the router itself, the
+    CLI wiring) may set hedge_ms/apply_knob freely."""
+    p = tmp_path / "plain.py"
+    p.write_text("def build(router):\n"
+                 "    router.hedge_ms = 25.0\n"
+                 "    router.apply_knob('max_wait_ms', 10.0)\n")
+    assert [f for f in analyze_paths([str(p)], root=str(tmp_path))
+            if f.rule_id == "R13"] == []
+
+
+def test_r13_hint_names_the_choke_point():
+    path = os.path.join(FIXTURES, "r13_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R13"][0]
+    assert "_actuate" in f.hint and "pdnlp_tpu.obs.decision" in f.hint
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -267,9 +299,9 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    # the registry sorts by id STRING (R10/R11/R12 between R1 and R2)
-    assert list(all_rules()) == ["R1", "R10", "R11", "R12", "R2", "R3",
-                                 "R4", "R5", "R6", "R7", "R8", "R9"]
+    # the registry sorts by id STRING (R10..R13 between R1 and R2)
+    assert list(all_rules()) == ["R1", "R10", "R11", "R12", "R13", "R2",
+                                 "R3", "R4", "R5", "R6", "R7", "R8", "R9"]
 
 
 # -------------------------------------------------------------- suppressions

@@ -487,6 +487,14 @@ def test_eject_flushes_spans_and_snapshot_to_disk(tmp_path):
         assert r.states[0] == "ejected"
         snap_path = os.path.join(tele_dir, "router_snapshot.json")
         trace_path = os.path.join(trace_dir, "trace_proc0.jsonl")
+        # the state flips at the TOP of _eject's locked block; the flush
+        # runs after the requeue work, outside the lock — poll briefly
+        # instead of racing the file write (the contract is "on disk
+        # without a clean exit", not "on disk the same microsecond")
+        deadline = time.monotonic() + 10
+        while not (os.path.exists(snap_path) and os.path.exists(trace_path)) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert os.path.exists(snap_path), "eject left no metrics snapshot"
         assert os.path.exists(trace_path), "eject left no span file"
         snap = json.load(open(snap_path))

@@ -21,6 +21,12 @@ Subcommands:
   placement ``(row, slot)``, dispatch, hedge/requeue/re-pack, completion —
   with per-hop gap durations; exits 1 when the chain is missing or
   incomplete;
+- ``decisions <trace...>`` — the serve control plane's decision-record
+  chains (``pdnlp_tpu.obs.decision``): per actuation, the cause metrics,
+  the knob's old -> new value, and the post-actuation evaluation-window
+  outcome (kept / auto-reverted, with the signal delta); exits 1 on a
+  malformed chain (an action without an outcome — an unexplained knob
+  turn);
 - ``export <trace> -o out.json`` — convert a compact JSONL span log to
   Chrome-trace JSON (load it at https://ui.perfetto.dev or
   ``chrome://tracing``).
@@ -33,6 +39,7 @@ Pure stdlib — runs on hosts without jax installed.
     python trace_tpu.py diff main.jsonl pr.jsonl --threshold 0.2
     python trace_tpu.py merge output/trace/trace_proc*.jsonl -o merged.json
     python trace_tpu.py request r12345-7 output/trace/trace_proc0.jsonl
+    python trace_tpu.py decisions output/trace/trace_proc0.jsonl
     python trace_tpu.py export output/trace/trace_proc0.jsonl -o t.json
 """
 from __future__ import annotations
@@ -41,6 +48,7 @@ import argparse
 import json
 import sys
 
+from pdnlp_tpu.obs.decision import format_decisions, validate_decisions
 from pdnlp_tpu.obs.export import (
     load_records, write_chrome_trace, write_jsonl,
 )
@@ -152,6 +160,16 @@ def cmd_request(ns) -> int:
     return 0 if chain and not chain_issues(chain) else 1
 
 
+def cmd_decisions(ns) -> int:
+    records = _load_many(ns.traces, hb_dir=ns.hb_dir)
+    report = validate_decisions(records)
+    if ns.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(format_decisions(records))
+    return 0 if not report["incomplete"] else 1
+
+
 def cmd_export(ns) -> int:
     out = ns.output or (ns.trace.rsplit(".", 1)[0] + ".chrome.json")
     write_chrome_trace(load_records(ns.trace), out)
@@ -218,6 +236,15 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--hb_dir", default=None)
     r.add_argument("--json", action="store_true")
     r.set_defaults(fn=cmd_request)
+
+    c = sub.add_parser("decisions", help="control-plane decision chains "
+                                         "(cause -> action -> outcome); "
+                                         "exit 1 on a malformed chain")
+    c.add_argument("traces", nargs="+",
+                   help="trace file(s); several are clock-aligned first")
+    c.add_argument("--hb_dir", default=None)
+    c.add_argument("--json", action="store_true")
+    c.set_defaults(fn=cmd_decisions)
 
     e = sub.add_parser("export", help="JSONL span log -> Chrome-trace JSON")
     e.add_argument("trace")
