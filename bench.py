@@ -2480,6 +2480,415 @@ def kernel_smoke(argv) -> None:
                  + f"\nsee {out_path}")
 
 
+def longcontext_smoke(argv) -> None:
+    """``--longcontext``: the long-context gate (ROADMAP item 3).
+
+    Six gated blocks, written to ``results/longcontext_smoke.json``
+    (override ``--longcontext_out``), non-zero exit on any violation:
+
+    1. **multi-tile kernel parity** — pallas fwd+bwd vs the XLA oracle at
+       EVERY supported width (``--longcontext_widths``, default
+       128/256/512), dense mask AND segment-native packed, plus the
+       measured tile-map sparsity (the block-sparse skip's live fraction);
+    2. **structural no-HBM-bias proof** — the jaxpr of a packed
+       ``bert.classify`` at 512 and 1024 carries NO [B, 1, S, S] tensor
+       under the pallas route (the XLA route must, as the control);
+    3. **packed multi-width train throughput at 512** — ``--length_mode
+       pack`` with 128/256/512 buckets vs the padded-full baseline over
+       the SAME jitted DP step: gates fill >= 0.85 (the padding-waste
+       headroom of the acceptance bar) and real-token throughput >=
+       0.6x the slot-advantage (fill ratio of the two layouts), with
+       zero post-warmup retraces;
+    4. **ring+packed parity** — the sequence-parallel packed train step
+       (ring attention, segment IDs sharded along seq) vs the
+       single-device packed step, same batch, loss parity over 2 steps
+       (recorded-skip on a single-device host);
+    5. **mixed long/short storm** — chunked prefill (long widths 512)
+       interleaved with a packed short-query storm on the online batcher:
+       gates the short p99 against a short-only control run, exact
+       long-request parity with whole-request scoring, zero lost;
+    6. **zero post-warmup retraces** across the storm (the serve compile
+       cache is closed by warmup, long widths included).
+
+    Summary rows merge into ``results/longcontext.json`` through
+    ``scripts/bench_longcontext.merge_rows`` — historical v5e rows are
+    never clobbered (error-free rows win over incoming ones).
+
+    On a CPU host the pallas kernels run in INTERPRET mode (numerics
+    identical, speed meaningless — the throughput gate compares packed
+    vs padded under the SAME backend, so the ratio stays meaningful) and
+    serve packing is forced on (``auto`` only packs on TPU).
+    """
+    import random
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pdnlp_tpu.data import Collator, DataLoader, WordPieceTokenizer, build_vocab
+    from pdnlp_tpu.data.collate import EncodedDataset
+    from pdnlp_tpu.data.packing import pack_id_lists, segment_bias, segment_cap
+    from pdnlp_tpu.data.sampler import DistributedShardSampler
+    from pdnlp_tpu.models import bert, get_config
+    from pdnlp_tpu.ops import flash
+    from pdnlp_tpu.ops.attention import (
+        ROUTING_TABLE, dot_product_attention, mask_bias, routed_impl,
+    )
+    from pdnlp_tpu.serve import DynamicBatcher, InferenceEngine
+    from pdnlp_tpu.train.setup import build_length_train_loader
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, out_path = pop_cli_flag(
+        argv, "--longcontext_out",
+        os.path.join("results", "longcontext_smoke.json"))
+    argv, widths_s = pop_cli_flag(argv, "--longcontext_widths", "128,256,512")
+    argv, epochs = pop_cli_flag(argv, "--longcontext_epochs", 2, int)
+    args = parse_cli(argv, base=Args(
+        model="bert-tiny-long", max_seq_len=512, train_batch_size=8,
+        learning_rate=1e-3, dropout=0.0, attn_dropout=0.0,
+        length_buckets="128,256,512", log_every=10 ** 9))
+    widths = tuple(int(w) for w in widths_s.split(",") if w.strip())
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    failures = []
+
+    # ---- 1. multi-tile kernel parity at every width, dense + packed ----
+    def packed_seg(B, S, seed):
+        r = np.random.RandomState(seed)
+        seg = np.zeros((B, S), np.int32)
+        for b in range(B):
+            pos, sid = 0, 0
+            while pos < S - 24:
+                ln = r.randint(8, 48)
+                sid += 1
+                seg[b, pos: pos + ln] = sid
+                pos += ln
+        return seg
+
+    parity = {}
+    Bk, N, D = 2, 2, 32
+    for S in widths:
+        if not flash.supported_seq(S):
+            failures.append(f"width {S} does not tile the kernel blocks")
+            continue
+        r = np.random.RandomState(args.seed)
+        q, k, v = (jnp.asarray(r.randn(Bk, S, N, D), jnp.float32)
+                   for _ in range(3))
+        seg = packed_seg(Bk, S, seed=S)
+        segj = jnp.asarray(seg)
+        seg_b = jnp.asarray(segment_bias(seg))
+        mask = jnp.asarray((r.rand(Bk, S) > 0.4).astype(np.int32)
+                           ).at[:, 0].set(1).at[-1, :].set(0)  # filler row
+        bias = mask_bias(mask)
+        cases = {
+            "dense": (lambda q, k, v: dot_product_attention(
+                q, k, v, bias, impl="xla"),
+                lambda q, k, v: flash.flash_attention(q, k, v, bias=bias)),
+            "packed": (lambda q, k, v: dot_product_attention(
+                q, k, v, bias=seg_b, impl="xla"),
+                lambda q, k, v: flash.flash_attention(
+                    q, k, v, segment_ids=segj)),
+        }
+        row = {}
+        for label, (ref_fn, ker_fn) in cases.items():
+            def loss(f):
+                return lambda q, k, v: (f(q, k, v).astype(jnp.float32)
+                                        ** 2).sum()
+            rv, rg = jax.jit(jax.value_and_grad(
+                loss(ref_fn), argnums=(0, 1, 2)))(q, k, v)
+            kv_, kg = jax.jit(jax.value_and_grad(
+                loss(ker_fn), argnums=(0, 1, 2)))(q, k, v)
+            fwd = abs(float(kv_) - float(rv)) / max(abs(float(rv)), 1.0)
+            bwd = max(float(jnp.abs(a - b).max()) for a, b in zip(rg, kg))
+            row[label] = {"fwd_rel": round(fwd, 9),
+                          "bwd_max_abs": round(bwd, 9)}
+            if fwd > 1e-5 or bwd > 5e-4:
+                failures.append(f"width {S} {label} parity: fwd={fwd:g} "
+                                f"bwd={bwd:g}")
+        row["tile_map_live_fraction"] = round(float(np.asarray(
+            flash.segment_block_map(segj)).mean()), 4)
+        parity[str(S)] = row
+
+    # ---- 2. structural no-HBM-bias proof at 512 and 1024 ---------------
+    def shapes_in(jaxpr, acc):
+        for eqn in jaxpr.eqns:
+            for ov in eqn.outvars:
+                aval = getattr(ov, "aval", None)
+                if aval is not None and getattr(aval, "shape", None):
+                    acc.add(tuple(aval.shape))
+            for p in eqn.params.values():
+                for sub in (p if isinstance(p, (list, tuple)) else [p]):
+                    inner = getattr(sub, "jaxpr", None)
+                    if inner is not None:
+                        shapes_in(inner, acc)
+        return acc
+
+    structural = {}
+    cfg_t = get_config("bert-tiny-long", vocab_size=160)
+    params_t = bert.init_params(jax.random.key(0), cfg_t)
+    r = np.random.RandomState(0)
+    for S in (512, 1024):
+        cap = segment_cap(S, 8)
+        lists = [list(r.randint(5, 150, r.randint(10, 100)))
+                 for _ in range(12)]
+        pbatch, _ = pack_id_lists(lists, S, rows=2, max_segments=cap)
+        pbatch = {k2: jnp.asarray(v2) for k2, v2 in pbatch.items()}
+        bias_shape = (2, 1, S, S)
+        got = {}
+        for impl in ("pallas", "xla"):
+            jx = jax.make_jaxpr(
+                lambda p, bt, impl=impl: bert.classify(p, cfg_t, bt,
+                                                       attn_impl=impl)
+            )(params_t, pbatch)
+            got[impl] = bias_shape in shapes_in(jx.jaxpr, set())
+        structural[str(S)] = got
+        if got["pallas"]:
+            failures.append(f"packed pallas route materializes the "
+                            f"{bias_shape} bias at width {S}")
+        if not got["xla"]:
+            failures.append(f"sanity: XLA control lost its {bias_shape} "
+                            f"materialization at width {S}")
+
+    # ---- 3. packed multi-width train throughput at 512 -----------------
+    chars = "天地人你我他好坏大小上下来去爱恨喜怒哀乐高兴悲伤讨厌愤怒"
+    rng = random.Random(args.seed)
+
+    def synth(n):
+        out = []
+        for _ in range(n):
+            p = rng.random()
+            ln = (rng.randint(6, 120) if p < 0.7 else
+                  rng.randint(121, 350) if p < 0.92 else
+                  rng.randint(351, 500))
+            text = "".join(rng.choice(chars) for _ in range(ln))
+            out.append((text, chars.index(text[0]) % args.num_labels))
+        return out
+
+    train_data = synth(512)
+    tok = WordPieceTokenizer(build_vocab((t for t, _ in train_data),
+                                         size=256))
+    col = Collator(tok, args.max_seq_len)
+    enc = EncodedDataset(train_data, tok, args.max_seq_len)
+    mesh, cfg, tx, state0, sh, step, put = _smoke_model(args, tok.vocab_size)
+
+    train_rows = {}
+    for mode in ("full", "pack"):
+        margs = args.replace(length_mode=mode)
+        loader = build_length_train_loader(margs, train_data, col, enc,
+                                           batch_size=args.train_batch_size)
+        state = jax.tree_util.tree_map(jnp.copy, state0)
+        pre = step._cache_size()
+        for batch in loader:  # warmup epoch: visit every shape, untimed
+            # jaxlint: disable=R7 — untimed warmup outside the measured loop
+            state, m = step(state, put(batch))
+        float(jax.device_get(m["loss"]))
+        compiled = step._cache_size() - pre
+        real = slots = steps = 0
+        t0 = time.monotonic()
+        for _ in range(epochs):
+            for batch in loader:
+                # the transport IS part of the measured tokens/s here and
+                # both modes pay it identically
+                # jaxlint: disable=R7 — transport is inside the metric
+                state, m = step(state, put(batch))
+                real += int(batch["attention_mask"].sum())
+                slots += int(batch["attention_mask"].size)
+                steps += 1
+        float(jax.device_get(m["loss"]))
+        elapsed = time.monotonic() - t0
+        retraces = step._cache_size() - pre - compiled
+        train_rows[mode] = {
+            "steps": steps, "compiled_variants": compiled,
+            "retraces_post_warmup": retraces,
+            "fill_ratio": round(real / slots, 4),
+            "tokens_real_per_sec": round(real / elapsed, 1),
+            "tokens_slot_per_sec": round(slots / elapsed, 1),
+            "attn_impl_packed_512": routed_impl(
+                args.attention_impl, 512, segmented=(mode == "pack")),
+        }
+        if retraces:
+            failures.append(f"train {mode}: {retraces} post-warmup "
+                            "retraces")
+    fill_packed = train_rows["pack"]["fill_ratio"]
+    fill_full = train_rows["full"]["fill_ratio"]
+    ratio = (train_rows["pack"]["tokens_real_per_sec"]
+             / max(train_rows["full"]["tokens_real_per_sec"], 1e-9))
+    headroom = fill_packed / max(fill_full, 1e-9)
+    train_rows["pack"]["real_token_speedup_vs_full"] = round(ratio, 3)
+    train_rows["pack"]["slot_advantage"] = round(headroom, 3)
+    if fill_packed < 0.85:
+        failures.append(f"packed fill {fill_packed} < 0.85")
+    if ratio < 0.6 * headroom:
+        failures.append(f"packed real-token throughput {ratio:.2f}x < "
+                        f"0.6 x slot advantage {headroom:.2f}")
+
+    # ---- 4. ring+packed vs single-device packed parity -----------------
+    ring = {"devices": jax.device_count()}
+    if jax.device_count() >= 2:
+        from jax.sharding import PartitionSpec  # noqa: F401
+        from pdnlp_tpu.parallel import make_mesh
+        from pdnlp_tpu.parallel.sp import make_sp_batch, make_sp_train_step
+        from pdnlp_tpu.train.steps import make_train_step
+
+        shape = ({"data": 2, "seq": 2} if jax.device_count() >= 4
+                 else {"seq": 2})
+        sp_mesh = make_mesh(shape=shape)
+        sargs = args.replace(dtype="float32")
+        scfg = get_config(args.model, vocab_size=tok.vocab_size,
+                          num_labels=args.num_labels, dropout=0.0,
+                          attn_dropout=0.0)
+        sparams = bert.init_params(jax.random.key(1), scfg)
+        from pdnlp_tpu.train.optim import build_optimizer
+        from pdnlp_tpu.train.steps import init_state
+        stx = build_optimizer(sparams, sargs)
+        sstate = init_state(jax.random.key(1), scfg, stx, params=sparams)
+        rb = np.random.RandomState(7)
+        lists = [list(rb.randint(5, tok.vocab_size - 1, rb.randint(12, 90)))
+                 for _ in range(24)]
+        pb, _ = pack_id_lists(lists, 256, rows=4, max_segments=16)
+        M = pb["cls_positions"].shape[1]
+        pb["label"] = rb.randint(0, args.num_labels, (4, M)).astype(np.int32)
+        pb["example_weight"] = (pb["cls_positions"] > 0).astype(np.float32)
+        pb["example_weight"][:, 0] = 1.0
+        put_sp = make_sp_batch(sp_mesh)
+        sp_step = make_sp_train_step(scfg, stx, sargs, sp_mesh)(put_sp(pb))
+        single = jax.jit(make_train_step(scfg, stx, sargs),
+                         donate_argnums=0)
+        s1 = jax.tree_util.tree_map(jnp.copy, sstate)
+        s2 = jax.tree_util.tree_map(jnp.copy, sstate)
+        diffs = []
+        for _ in range(2):
+            s1, m1 = sp_step(s1, put_sp(pb))
+            s2, m2 = single(s2, {k2: jnp.asarray(v2)
+                                 for k2, v2 in pb.items()})
+            diffs.append(abs(float(m1["loss"]) - float(m2["loss"])))
+        ring.update({"mesh": shape, "loss_max_abs_diff": max(diffs)})
+        if max(diffs) > 2e-5:
+            failures.append(f"ring+packed loss diverges from single-device "
+                            f"packed by {max(diffs):g}")
+    else:
+        ring["skipped"] = "single-device host — parity pinned by " \
+                          "tests/test_longcontext.py on the CPU mesh"
+
+    # ---- 5/6. mixed long/short storm + retrace gate --------------------
+    sargs = args.replace(max_seq_len=512)
+    eng = InferenceEngine(sargs, tokenizer=tok)
+    bat = DynamicBatcher(eng, buckets=(128,), max_batch_size=8,
+                         max_wait_ms=8.0, max_queue=256,
+                         serve_pack="on" if not on_tpu else "auto",
+                         pack_max_segments=16,
+                         long_widths=(256, 512)).start()
+    bat.warmup()
+    rs = np.random.RandomState(11)
+
+    def short_ids():
+        return [2] + list(rs.randint(5, tok.vocab_size - 1,
+                                     rs.randint(4, 40))) + [3]
+
+    def long_ids():
+        return [2] + list(rs.randint(5, tok.vocab_size - 1,
+                                     rs.randint(300, 480))) + [3]
+
+    def storm(n_short, every_long):
+        futs, longs = [], []
+        lat = []
+        for i in range(n_short):
+            if every_long and i % every_long == 0:
+                lf = bat.submit_ids(long_ids())
+                longs.append(lf)
+            futs.append((time.monotonic(), bat.submit_ids(short_ids())))
+            time.sleep(0.002)
+        for t0s, f in futs:
+            f.result(timeout=60)
+            lat.append((time.monotonic() - t0s) * 1e3)
+        lres = [(f.ids, f.result(timeout=60)) for f in longs]
+        return np.asarray(lat), lres
+
+    warm_retraces = eng.metrics.retraces.value
+    control, _ = storm(200, 0)
+    mixed, long_results = storm(200, 10)
+    storm_retraces = eng.metrics.retraces.value - warm_retraces
+    p99_control = float(np.percentile(control, 99))
+    p99_mixed = float(np.percentile(mixed, 99))
+    budget = max(3 * p99_control, p99_control + 250.0)
+    serve_row = {
+        "short_p99_ms_control": round(p99_control, 1),
+        "short_p99_ms_mixed": round(p99_mixed, 1),
+        "short_p99_budget_ms": round(budget, 1),
+        "long_requests": len(long_results),
+        "retraces_in_storm": storm_retraces,
+    }
+    if p99_mixed > budget:
+        failures.append(f"mixed-storm short p99 {p99_mixed:.0f}ms blows "
+                        f"the {budget:.0f}ms budget (control "
+                        f"{p99_control:.0f}ms)")
+    if storm_retraces:
+        failures.append(f"{storm_retraces} post-warmup retraces in the "
+                        "storm (long widths must be closed by warmup)")
+    # chunked-prefill parity: every long result == whole-request scoring
+    worst = 0.0
+    for ids, got in long_results:
+        w = 256 if len(ids) <= 256 else 512
+        ref = eng.infer_ids([list(ids)], w)[0]
+        worst = max(worst, float(np.abs(got - ref).max()))
+    serve_row["long_parity_max_abs"] = worst
+    if worst > 2e-5:
+        failures.append(f"chunked-prefill parity {worst:g} > 2e-5")
+    bat.stop()
+
+    result = {
+        "metric": "longcontext_smoke",
+        "model": args.model,
+        "platform": platform,
+        "pallas_interpreted": not on_tpu,
+        "devices": jax.device_count(),
+        "widths": list(widths),
+        "routing_table": {f"{k[0]}{'_packed' if k[1] else '_dense'}": v
+                          for k, v in sorted(ROUTING_TABLE.items())},
+        "kernel_parity": parity,
+        "segment_bias_materialized": structural,
+        "train_512": train_rows,
+        "ring_packed": ring,
+        "mixed_storm": serve_row,
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, out_path)
+    # merge the summary rows into results/longcontext.json — history wins
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import bench_longcontext as blc
+
+    # row names carry the PLATFORM: merge_rows is history-wins, so an
+    # un-keyed name written by a CPU smoke would forever block the
+    # documented on-chip re-measurement from landing — per-platform names
+    # let the v5e run coexist with (not fight) the CI numbers
+    smoke_rows = {
+        f"smoke_pack512_train_{platform}": {
+            **{k2: train_rows["pack"][k2] for k2 in
+               ("fill_ratio", "tokens_real_per_sec",
+                "real_token_speedup_vs_full")},
+            "config": {"seq": 512, "source": "bench.py --longcontext",
+                       "platform": platform,
+                       "pallas_interpreted": not on_tpu}},
+        f"smoke_mixed_storm_{platform}": {
+            **serve_row,
+            "config": {"source": "bench.py --longcontext",
+                       "platform": platform}},
+    }
+    _, merged = blc.merge_rows(smoke_rows)
+    print(json.dumps(result))
+    print(f"[longcontext] merged rows into results/longcontext.json: "
+          f"{merged}", file=sys.stderr)
+    if failures:
+        sys.exit("longcontext smoke FAILED:\n  - " + "\n  - ".join(failures)
+                 + f"\nsee {out_path}")
+
+
 def resilience_smoke(argv) -> None:
     """``--resilience``: preemption-grade training smoke.
 
@@ -2743,6 +3152,18 @@ def main() -> None:
         # kernel_smoke.json) — like --pipeline/--length, not an Args knob
         argv.remove("--kernels")
         return kernel_smoke(argv)
+    if "--longcontext" in argv:
+        # long-context gate (multi-tile kernel parity, structural no-bias
+        # proof, packed-512 throughput, ring+packed parity, mixed-storm
+        # p99 — results/longcontext_smoke.json); an intercept like
+        # --kernels.  The ring leg needs >1 device: give the CPU host its
+        # virtual mesh BEFORE jax initializes (no-op for TPU backends,
+        # the flag only shapes the host platform).
+        if "jax" not in sys.modules:
+            os.environ.setdefault(
+                "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        argv.remove("--longcontext")
+        return longcontext_smoke(argv)
     if "--replay" in argv:
         # trace-driven load replay: controller-vs-static across replayed
         # traffic shapes (results/replay_smoke.json) — an intercept like

@@ -17,4 +17,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r11_unpacked_serve_forward,
     r12_device_span_attr,
     r13_unrecorded_actuation,
+    r14_quadratic_bias,
 )

@@ -1,0 +1,160 @@
+"""R14 — a quadratic [B, 1, S, S] segment/attention bias materialized on a
+hot path at long width.
+
+The long-context push (ops/flash.py multi-tile kernels, PR 12) exists to
+DELETE this tensor: at S=2048 a single bf16 ``[B, 1, S, S]`` bias is 8 MB
+per batch row per materialization — quadratic HBM traffic the segment-
+native kernel replaces with linear-in-S ID vectors and a ``(S/128)^2``
+tile map.  Re-introducing the materialization in a step builder or serve
+forward silently re-caps the stack at short widths, and no retrace or
+parity gate catches it (the math is identical, only the roofline moves).
+
+Heuristics, scoped to *hot-path* functions (R8's scope: step-builder- or
+step-shaped names, serve forwards, including nested defs), in modules
+that import jax:
+
+- a call resolving to ``data.packing.segment_bias`` — the sanctioned
+  materialization lives INSIDE ``ops.attention`` (the XLA fallback);
+  any hot-path caller above it is hoisting the bias back into HBM;
+- the ID-outer-product idiom ``seg[:, :, None] == seg[:, None, :]`` (any
+  broadcast-axis arrangement, same base variable both sides) — the
+  expression that births the [B, S, S] mask;
+- an explicit allocation (``jnp.zeros``/``ones``/``full``/
+  ``broadcast_to``) whose literal shape carries two equal trailing
+  integer dims >= 512 — the statically-visible [.., S, S] buffer.
+
+Width is only statically knowable in the literal-shape form; the first
+two forms are flagged at any width — the materialization idiom is the
+hazard class, and the routed alternative (pass ``segment_ids`` through)
+costs nothing at short widths either.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional
+
+from pdnlp_tpu.analysis.core import Finding, ModuleInfo, Rule, register
+
+_HOT_NAME_RE = re.compile(
+    r"^(build|make)_\w*step\w*$|^\w*step(_fn)?$|^_?forward$")
+_SEGMENT_BIAS = {"pdnlp_tpu.data.packing.segment_bias",
+                 "data.packing.segment_bias", "packing.segment_bias",
+                 "segment_bias"}
+_ALLOC = {"jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full",
+          "jax.numpy.empty", "jax.numpy.broadcast_to",
+          "numpy.zeros", "numpy.ones", "numpy.full",
+          "numpy.broadcast_to"}
+_WIDTH_FLOOR = 512
+#: the one sanctioned materialization site: ops.attention's XLA fallback
+_EXEMPT_PATH_RE = re.compile(r"(^|/)pdnlp_tpu/ops/attention\.py$")
+
+
+def _imports_jax(mod: ModuleInfo) -> bool:
+    return any(v == "jax" or v.startswith("jax.")
+               for v in mod.aliases.values())
+
+
+def _bcast_pattern(node: ast.AST) -> Optional[tuple]:
+    """``x[:, :, None]``-style subscript -> (base name, axes tuple) where
+    axes are "s" (a slice) or "n" (a broadcast None); else None."""
+    if not isinstance(node, ast.Subscript):
+        return None
+    base = node.value
+    if not isinstance(base, ast.Name):
+        return None
+    sl = node.slice
+    elts = list(sl.elts) if isinstance(sl, ast.Tuple) else [sl]
+    axes: List[str] = []
+    for e in elts:
+        if isinstance(e, ast.Slice) and e.lower is None and e.upper is None:
+            axes.append("s")
+        elif isinstance(e, ast.Constant) and e.value is None:
+            axes.append("n")
+        else:
+            return None
+    if "n" not in axes:
+        return None
+    return base.id, tuple(axes)
+
+
+def _quadratic_literal_shape(call: ast.Call) -> Optional[int]:
+    """The repeated trailing dim when the call's shape argument is a
+    literal tuple whose last two integer dims are equal and >= 512."""
+    shapes = [a for a in list(call.args) + [kw.value for kw in call.keywords
+                                            if kw.arg == "shape"]
+              if isinstance(a, (ast.Tuple, ast.List))]
+    for shp in shapes:
+        dims = [e.value for e in shp.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+        if len(shp.elts) >= 2 and len(dims) >= 2 \
+                and dims[-1] == dims[-2] and dims[-1] >= _WIDTH_FLOOR:
+            return dims[-1]
+    return None
+
+
+@register
+class QuadraticBiasAtWidth(Rule):
+    rule_id = "R14"
+    name = "quadratic-bias-at-width"
+    hint = ("pass the raw segment_ids through to ops.attention instead: "
+            "the pallas kernel masks in-VMEM from the IDs (and skips dead "
+            "tiles), the XLA fallback builds the bias at its ONE "
+            "sanctioned site inside ops/attention.py — a hot-path "
+            "[B, 1, S, S] bias is quadratic HBM traffic the long-context "
+            "kernels exist to delete")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if _EXEMPT_PATH_RE.search(mod.path.replace("\\", "/")):
+            return
+        if not _imports_jax(mod):
+            return
+        seen: set = set()
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _HOT_NAME_RE.fullmatch(fn.name):
+                continue
+            yield from self._check_body(mod, fn, seen)
+
+    def _check_body(self, mod: ModuleInfo, fn: ast.AST,
+                    seen: set) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                if mod.resolves_to(node.func, _SEGMENT_BIAS):
+                    seen.add(key)
+                    yield self.finding(
+                        mod, node,
+                        "segment_bias materialized in a hot-path builder "
+                        "— the [B, 1, S, S] mask belongs in-kernel (route "
+                        "segment_ids), not in HBM")
+                elif mod.resolves_to(node.func, _ALLOC):
+                    width = _quadratic_literal_shape(node)
+                    if width is not None:
+                        seen.add(key)
+                        yield self.finding(
+                            mod, node,
+                            f"[.., {width}, {width}] attention-bias "
+                            "buffer allocated in a hot-path builder — "
+                            f"quadratic at width {width} (>= "
+                            f"{_WIDTH_FLOOR}); mask from segment_ids/"
+                            "attention_mask channels instead")
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                left = _bcast_pattern(node.left)
+                right = _bcast_pattern(node.comparators[0])
+                if left and right and left[0] == right[0] \
+                        and left[1] != right[1]:
+                    seen.add(key)
+                    yield self.finding(
+                        mod, node,
+                        "ID outer-product compare "
+                        f"({left[0]}[...] == {right[0]}[...]) in a "
+                        "hot-path builder births the [B, S, S] mask — "
+                        "route the IDs to ops.attention instead")

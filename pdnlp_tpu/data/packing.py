@@ -54,6 +54,59 @@ def pack_texts(
     return {"input_ids": input_ids, "segment_ids": segment_ids}
 
 
+class _BfdPacker:
+    """Best-fit-decreasing placement core: feed items longest first; each
+    goes to the open row with the LEAST free space that still fits it —
+    O(n log n) via a bisect-sorted (free, row) list; a row at the segment
+    cap closes.  Deterministic: ties break on row id (stable tuple
+    order).  ONE copy of the placement invariants, shared by the
+    single-width packer and the multi-width seed/backfill passes."""
+
+    def __init__(self, S: int, M: int):
+        self.S, self.M = int(S), int(M)
+        self.rows: List[List[int]] = []
+        self._open: List[tuple] = []  # sorted (free_tokens, row_id)
+
+    @property
+    def has_open(self) -> bool:
+        return bool(self._open)
+
+    def place(self, i: int, L: int, open_new: bool = True) -> bool:
+        """Place item ``i`` of ``L`` tokens; ``open_new=False`` restricts
+        to existing open rows (the backfill pass never opens rows)."""
+        j = bisect.bisect_left(self._open, (L, -1))
+        if j < len(self._open):
+            free, rid = self._open.pop(j)
+            self.rows[rid].append(i)
+            if len(self.rows[rid]) < self.M and free - L > 0:
+                bisect.insort(self._open, (free - L, rid))
+            return True
+        if not open_new:
+            return False
+        self.rows.append([i])
+        if self.M > 1 and self.S - L > 0:
+            bisect.insort(self._open, (self.S - L, len(self.rows) - 1))
+        return True
+
+
+def _bfd_rows(lengths: np.ndarray, S: int, M: int) -> List[List[int]]:
+    """Pack every item (longest first) via :class:`_BfdPacker`; returns
+    rows of POSITIONS into ``lengths``."""
+    packer = _BfdPacker(S, M)
+    for i in np.argsort(-np.asarray(lengths), kind="stable").tolist():
+        packer.place(i, int(lengths[i]))
+    return packer.rows
+
+
+def segment_cap(width: int, base_cap: int, base_width: int = 128) -> int:
+    """Per-width segment capacity: ``--pack_max_segments`` is defined at
+    the base (128-token, one kernel block) width and scales linearly with
+    the row width, so a 512-wide packed row admits 4x the segments a
+    128-wide one does — same expected density, per-width ``[N, M]``
+    channel shapes stay bounded."""
+    return max(1, int(base_cap) * int(width) // int(base_width))
+
+
 class PackedClassificationDataset(EncodedDataset):
     """Classification examples packed many-per-row — the fine-tune twin of
     :func:`pack_texts` (``--length_mode pack``).
@@ -72,6 +125,15 @@ class PackedClassificationDataset(EncodedDataset):
     - ``label`` / ``example_weight`` ``[N, M]``: per-SEGMENT targets and
       weights (0 = empty slot), so the loss stays per-example, not per-row.
 
+    ``width`` overrides the row width (default: the encoding width) —
+    the multi-width path (:class:`MultiWidthPackedDataset`) packs each
+    length bucket at its own kernel-tiling width.  ``subset`` restricts
+    packing to those encoded-example indices (the bucket's members);
+    labels and tokens are still read from the full encoded split.
+    ``rows`` (lists of encoded-example indices) bypasses the packer and
+    assembles exactly those rows — the multi-width container computes
+    its own backfilled packing and hands the rows here for assembly.
+
     Packing is computed ONCE (best-fit-decreasing, seeded by nothing —
     deterministic in the data): epochs shuffle packed *rows*, keeping the
     per-epoch step count and resume arithmetic exact.  What changes vs the
@@ -79,32 +141,38 @@ class PackedClassificationDataset(EncodedDataset):
     any example's own tokens, mask, or loss weight.
     """
 
-    def __init__(self, encoded: EncodedDataset, max_segments: int = 16):
-        S = encoded.seq_len
+    def __init__(self, encoded: EncodedDataset, max_segments: int = 16,
+                 width: Optional[int] = None,
+                 subset: Optional[Sequence[int]] = None,
+                 rows: Optional[List[List[int]]] = None):
+        S = int(width) if width else encoded.seq_len
         M = int(max_segments)
         if M < 1:
             raise ValueError(f"pack_max_segments must be >= 1, got {M}")
-        lengths = encoded.lengths()
-        n = len(encoded)
-        # best-fit-decreasing: for each example (longest first) pick the
-        # open row with the LEAST free space that still fits it — O(n log n)
-        # via a bisect-sorted (free, row) list; a row at the segment cap
-        # closes.  Deterministic: ties break on row id (stable tuple order).
-        order = np.argsort(-lengths, kind="stable")
-        rows: List[List[int]] = []
-        open_rows: List[tuple] = []  # sorted (free_tokens, row_id)
-        for i in order.tolist():
-            L = int(lengths[i])
-            j = bisect.bisect_left(open_rows, (L, -1))
-            if j < len(open_rows):
-                free, rid = open_rows.pop(j)
-                rows[rid].append(i)
-                if len(rows[rid]) < M and free - L > 0:
-                    bisect.insort(open_rows, (free - L, rid))
-            else:
-                rows.append([i])
-                if M > 1 and S - L > 0:
-                    bisect.insort(open_rows, (S - L, len(rows) - 1))
+        all_len = encoded.lengths()
+        if rows is None:
+            members_idx = (np.arange(len(encoded), dtype=np.int64)
+                           if subset is None
+                           else np.asarray(subset, np.int64))
+            lengths = all_len[members_idx]
+            if len(members_idx) and int(lengths.max()) > S:
+                raise ValueError(
+                    f"cannot pack a {int(lengths.max())}-token example "
+                    f"into {S}-wide rows — the packing width must cover "
+                    "every member (partition by covering width first)")
+            rows_pos = _bfd_rows(lengths, S, M)
+            rows = [[int(members_idx[i]) for i in r] for r in rows_pos]
+            n = len(members_idx)
+        else:
+            rows = [[int(i) for i in r] for r in rows]
+            for r in rows:
+                if len(r) > M:
+                    raise ValueError(f"row carries {len(r)} segments, "
+                                     f"cap is {M}")
+                if int(all_len[r].sum()) > S:
+                    raise ValueError("row overflows the packing width")
+            n = sum(len(r) for r in rows)
+        lengths = all_len  # assembly below indexes ORIGINAL example ids
         N = len(rows)
         src_ids = encoded.arrays["input_ids"]
         src_lab = encoded.arrays["label"]
@@ -114,11 +182,12 @@ class PackedClassificationDataset(EncodedDataset):
         cls_pos = np.zeros((N, M), np.int32)
         label = np.zeros((N, M), np.int32)
         weight = np.zeros((N, M), np.float32)
+        source_rows: List[List[int]] = [list(r) for r in rows]
         for r, members in enumerate(rows):
             off = 0
-            for s, i in enumerate(members):
-                L = int(lengths[i])
-                input_ids[r, off: off + L] = src_ids[i, :L]
+            for s, orig in enumerate(members):
+                L = int(lengths[orig])
+                input_ids[r, off: off + L] = src_ids[orig, :L]
                 segment_ids[r, off: off + L] = s + 1
                 # positions restart per segment: each example sees exactly
                 # the position embeddings its unpacked encoding would —
@@ -126,7 +195,7 @@ class PackedClassificationDataset(EncodedDataset):
                 # row-offset shift (tests/test_length.py pins it)
                 position_ids[r, off: off + L] = np.arange(L, dtype=np.int32)
                 cls_pos[r, s] = off
-                label[r, s] = src_lab[i]
+                label[r, s] = src_lab[orig]
                 weight[r, s] = 1.0
                 off += L
         self.arrays = {
@@ -141,8 +210,12 @@ class PackedClassificationDataset(EncodedDataset):
         }
         self.n = N
         self.seq_len = S
+        self.width = S
         self.max_segments = M
         self.num_examples = n
+        #: per packed row, the ORIGINAL encoded-example indices riding it
+        #: (coverage/parity tests and the multi-width container use it)
+        self.source_rows = source_rows
 
     def stats(self) -> Dict[str, float]:
         """Packing efficiency numbers for the bench smoke."""
@@ -164,6 +237,142 @@ def pack_classification(encoded: EncodedDataset, max_segments: int = 16
                         ) -> PackedClassificationDataset:
     """Pack an encoded classification split into multi-example rows."""
     return PackedClassificationDataset(encoded, max_segments=max_segments)
+
+
+class MultiWidthPackedDataset:
+    """The multi-width pack layout (``--length_mode pack`` with several
+    kernel-tiling widths in ``--length_buckets``): each example lands in
+    the SMALLEST covering width bucket and each bucket packs at its own
+    width (one :class:`PackedClassificationDataset` per width, segment cap
+    scaled by :func:`segment_cap`), so a long-document split does not pad
+    its short tail up to the long width — short docs ride dense 128/256
+    rows while the long ones pack 512/1024/2048 rows, all on the exact
+    channel layout the segment-native flash kernel consumes.
+
+    Packing runs WIDEST-FIRST with backfill: a width's rows are seeded by
+    the examples that NEED it (covering width = this width) via
+    best-fit-decreasing, then topped up from the still-unpacked shorter
+    examples (longest first, same best-fit placement) — a 512-wide row
+    holding one 300-token document backfills with ~200 tokens of short
+    documents instead of padding.  Without backfill the per-row residue
+    caps fill near the mean member length over the width (~0.75); with it
+    the measured fill clears the 0.85 gate (``bench.py --longcontext``).
+
+    Rows live in ONE global index space (width groups concatenated in
+    ascending width order); batching rides the ordinary
+    :class:`~pdnlp_tpu.data.sampler.LengthGroupedSampler` over
+    :meth:`row_width_table` with the widths as the buckets — batches stay
+    width-homogeneous, the compile count stays bounded at
+    ``len(widths) x step-variants``, and the epoch structure is
+    epoch-invariant, exactly the bucket-mode contract.  Not an
+    :class:`~pdnlp_tpu.data.collate.EncodedDataset` (there is no single
+    rectangular array), so the device-resident pipeline declines it and
+    ``--pipeline auto`` falls back to prefetch — documented, measured in
+    ``bench.py --longcontext``.
+    """
+
+    def __init__(self, encoded: EncodedDataset, widths: Sequence[int],
+                 max_segments: int = 16, base_width: int = 128):
+        ws = tuple(sorted(int(w) for w in set(widths)))
+        if not ws:
+            raise ValueError("need at least one packing width")
+        lengths = encoded.lengths()
+        if len(encoded) and int(lengths.max()) > ws[-1]:
+            raise ValueError(
+                f"longest example ({int(lengths.max())} tokens) exceeds "
+                f"the largest packing width {ws[-1]} — include a covering "
+                "width in --length_buckets")
+        edges = np.asarray(ws, np.int64)
+        member = edges[np.minimum(np.searchsorted(edges, lengths),
+                                  len(edges) - 1)]
+        # widest-first with backfill (class docstring): each width packs
+        # its REQUIRED members, then draws from the shorter remainder
+        remaining = {w: set(np.flatnonzero(member == w).tolist())
+                     for w in ws}
+        rows_by_width: Dict[int, List[List[int]]] = {}
+        for w in reversed(ws):
+            packer = _BfdPacker(w, segment_cap(w, max_segments, base_width))
+            need = sorted(remaining[w], key=lambda i: (-lengths[i], i))
+            remaining[w] = set()
+            for i in need:                # seed: the width's own members
+                packer.place(i, int(lengths[i]))
+            pool = sorted((i for w2 in ws if w2 < w for i in remaining[w2]),
+                          key=lambda i: (-lengths[i], i))
+            for i in pool:                # backfill: no new rows opened
+                if not packer.has_open:
+                    break
+                if packer.place(i, int(lengths[i]), open_new=False):
+                    remaining[edges[np.searchsorted(edges,
+                                                    lengths[i])]].discard(i)
+            if packer.rows:
+                rows_by_width[w] = packer.rows
+        self.widths = ws
+        self.groups: Dict[int, PackedClassificationDataset] = {}
+        self._offsets: Dict[int, int] = {}
+        off = 0
+        for w in ws:
+            if w not in rows_by_width:
+                continue
+            g = PackedClassificationDataset(
+                encoded, max_segments=segment_cap(w, max_segments,
+                                                  base_width),
+                width=w, rows=rows_by_width[w])
+            self.groups[w] = g
+            self._offsets[w] = off
+            off += g.n
+        self.n = off
+        self.seq_len = ws[-1]          # widest row (HBM-budget shape)
+        self.num_examples = len(encoded)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def row_width_table(self) -> np.ndarray:
+        """[n] row widths — the ``lengths`` input of the
+        ``LengthGroupedSampler`` that batches this dataset (with
+        ``buckets=self.widths`` the covering bucket IS the row's width)."""
+        out = np.zeros((self.n,), np.int64)
+        for w, g in self.groups.items():
+            off = self._offsets[w]
+            out[off: off + g.n] = w
+        return out
+
+    def lengths(self) -> np.ndarray:
+        """Real token count per packed row (parity with EncodedDataset)."""
+        out = np.zeros((self.n,), np.int64)
+        for w, g in self.groups.items():
+            off = self._offsets[w]
+            out[off: off + g.n] = g.lengths()
+        return out
+
+    def take(self, indices: Sequence[int], pad_to: int = 0,
+             seq_len: int = 0) -> Dict[str, np.ndarray]:
+        """Assemble one width-homogeneous batch of packed rows.
+
+        ``seq_len`` names the batch's width (the sampler supplies it);
+        every index must belong to that width's group — the sampler
+        guarantees it, and mixing widths is a hard error, not a pad."""
+        w = int(seq_len) or self.seq_len
+        if w not in self.groups:
+            raise ValueError(f"no packed rows at width {w} "
+                             f"(have {sorted(self.groups)})")
+        off, g = self._offsets[w], self.groups[w]
+        local = np.asarray(indices, np.int64) - off
+        if len(local) and (local.min() < 0 or local.max() >= g.n):
+            raise ValueError(
+                f"batch mixes widths: indices outside the width-{w} group")
+        return g.take(local, pad_to=pad_to)
+
+    def stats(self) -> Dict[str, object]:
+        """Per-width packing stats + the token-weighted aggregate fill."""
+        per = {int(w): g.stats() for w, g in self.groups.items()}
+        slots = sum(g.n * w for w, g in self.groups.items())
+        real = sum(int(g.arrays["attention_mask"].sum())
+                   for g in self.groups.values())
+        return {"by_width": per,
+                "rows": self.n,
+                "examples": self.num_examples,
+                "fill_ratio": real / float(slots) if slots else 0.0}
 
 
 def pack_id_lists(

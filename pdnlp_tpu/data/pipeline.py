@@ -608,10 +608,15 @@ def build_pipeline(args, loader, put: Optional[Callable] = None,
     if not allow_resident:
         refusal = ("this strategy slices batches across seq/stage axes — "
                    "the resident gather assumes plain data-axis placement")
-    elif getattr(loader, "encoded", None) is None:
-        refusal = ("loader has no EncodedDataset (collator-driven batches "
-                   "may shuffle/augment per epoch; there is no frozen "
-                   "encoding to hold resident)")
+    elif getattr(loader, "encoded", None) is None \
+            or not hasattr(loader.encoded, "arrays"):
+        # no EncodedDataset, or an encoded-like without ONE rectangular
+        # array set (MultiWidthPackedDataset holds per-width groups) —
+        # nothing the resident gather could hold as a single residency
+        refusal = ("loader has no resident-eligible EncodedDataset "
+                   "(collator-driven batches may shuffle/augment per "
+                   "epoch; multi-width packed splits have no single "
+                   "rectangular encoding to hold resident)")
     elif jax.process_count() > 1:
         refusal = "multi-process run: the split spans host processes"
     else:

@@ -101,6 +101,45 @@ def parse_buckets(spec: str, max_seq_len: int) -> Tuple[int, ...]:
     return tuple(sorted(w for w in widths if w < max_seq_len)) + (max_seq_len,)
 
 
+def validate_length_buckets(widths: Sequence[int], *, max_position: int,
+                            model: str, mode: str = "bucket",
+                            max_seq_len: int = None) -> None:
+    """SETUP-time position-table validation of ``--length_buckets``.
+
+    Position embeddings are a gather into the model's ``[max_position, H]``
+    table, and JAX clamps out-of-bounds gathers instead of raising — an
+    unpacked 1024-wide bucket on bert-base (512 positions) would silently
+    train on garbage embeddings for every position past 511.  Loudly
+    refuse at setup instead, with the fix named.
+
+    - ``mode="bucket"`` (unpacked rows, positions 0..width-1): every
+      bucket width must fit the table;
+    - ``mode="pack"`` (packed rows, positions restart per segment): the
+      bound is the longest possible SEGMENT — the encode width
+      (``max_seq_len``) — so pack widths may legitimately exceed the
+      table (a 2048-wide packed row of <=512-token documents is exactly
+      the long-context payoff).
+    """
+    if mode == "bucket":
+        bad = sorted(int(w) for w in widths if int(w) > int(max_position))
+        if bad:
+            raise ValueError(
+                f"--length_buckets includes {bad} but {model}'s position "
+                f"table has only {max_position} positions — an unpacked "
+                f"{bad[0]}-wide batch would gather position embeddings "
+                "past the table (JAX clamps the gather: silent garbage, "
+                "no error).  Use a long-position model (--model "
+                "bert-base-long has 2048 positions) or drop the bucket")
+    elif max_seq_len is not None and int(max_seq_len) > int(max_position):
+        raise ValueError(
+            f"--length_mode pack with --max_seq_len {max_seq_len} exceeds "
+            f"{model}'s {max_position}-position table — packed positions "
+            "restart per segment, so the bound is the longest segment "
+            "(= the encode width), and a longer one would silently gather "
+            "garbage position embeddings.  Lower --max_seq_len or use a "
+            "long-position model (--model bert-base-long)")
+
+
 def resolve_length_mode(args) -> str:
     """The ``--length_mode`` decision, in one place.
 

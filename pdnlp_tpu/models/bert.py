@@ -222,8 +222,10 @@ def encode(
     preferred packed-path mask input: the block-diagonal mask is ROUTED,
     not materialized here.  A pallas-routed attention computes it inside
     the kernel (``ops.flash``); the XLA fallback builds
-    ``data.packing.segment_bias`` inside ``ops.attention``.  Either way
-    this module never holds the [B, 1, S, S] bias.
+    ``data.packing.segment_bias`` inside ``ops.attention``; under
+    ``seq_axis`` the sharded IDs ride the ring and each hop masks its
+    own shard-local block (``ops.ring``).  On every route this module
+    never holds the [B, 1, S, S] bias.
 
     ``position_ids``: optional explicit [B, S] position-embedding indices
     (packed rows restart positions per segment); default is the row
@@ -232,10 +234,16 @@ def encode(
     B, S = input_ids.shape
     shard_offset = 0
     if seq_axis is not None:
+        from pdnlp_tpu.parallel.compat import axis_size
+
         shard_offset = jax.lax.axis_index(seq_axis) * S
-        if S * jax.lax.axis_size(seq_axis) > cfg.max_position:
+        if position_ids is None and S * axis_size(seq_axis) > cfg.max_position:
             raise ValueError("global sequence exceeds max_position")
-    elif S > cfg.max_position:
+    elif position_ids is None and S > cfg.max_position:
+        # explicit position_ids (packed rows restart per segment) carry
+        # their own bound — the longest SEGMENT, validated at setup
+        # (data.sampler.validate_length_buckets); rows may be wider than
+        # the table, that is the packed long-context payoff
         raise ValueError(
             f"sequence length {S} exceeds max_position {cfg.max_position}; "
             "JAX gather would silently clamp position embeddings")
@@ -244,19 +252,20 @@ def encode(
                    shard_offset=shard_offset, position_ids=position_ids)
 
     ring_bias = bias = None
-    if attn_bias is not None or segment_ids is not None:
+    if attn_bias is not None:
         if seq_axis is not None:
-            raise ValueError("attn_bias/segment_ids overrides are not "
-                             "supported on the sequence-parallel (ring "
-                             "attention) path")
-        if attn_bias is not None and segment_ids is not None:
+            raise ValueError("attn_bias overrides are not supported on the "
+                             "sequence-parallel (ring attention) path")
+        if segment_ids is not None:
             raise ValueError("pass attn_bias OR segment_ids, not both — "
                              "the packed mask rides the IDs (padding is "
                              "segment 0), an explicit bias replaces it")
-        if attn_bias is not None:
-            bias = attn_bias.astype(dtype)
-        # segment_ids: bias stays None — the mask rides the IDs into
-        # ops.attention (in-kernel on pallas, segment_bias on XLA)
+        bias = attn_bias.astype(dtype)
+    elif segment_ids is not None:
+        # bias stays None on EVERY route — the mask rides the IDs: in-kernel
+        # on pallas, segment_bias inside ops.attention on XLA, per-hop
+        # shard-local blocks on the ring (ops.ring receives the sharded IDs)
+        pass
     elif seq_axis is None:
         bias = mask_bias(attention_mask, dtype)
     else:
@@ -353,6 +362,7 @@ def run_layers(layers: Params, cfg: BertConfig, x: jax.Array, *,
                 q, k, v, ring_bias, axis_name=seq_axis,
                 dropout_rate=0.0 if deterministic else cfg.attn_dropout,
                 dropout_rng=None if deterministic else jax.random.fold_in(rng, 3 * idx + 2),
+                segment_ids=segment_ids,
             )
         else:
             attn = dot_product_attention(
@@ -614,10 +624,6 @@ def classify(
     — the input contract of the fused projection+CE kernel
     (``ops.fused_ce``), which consumes the classifier weights itself."""
     packed = "cls_positions" in batch
-    if packed and seq_axis is not None:
-        raise ValueError("packed classification rows are not supported on "
-                         "the sequence-parallel (ring attention) path — "
-                         "the block-diagonal bias cannot ride the ring")
     if not deterministic:
         rng, enc_rng, drop_rng = jax.random.split(rng, 3)
     else:
@@ -635,7 +641,23 @@ def classify(
     if packed:
         # per-segment pooled-output gather: [B, S, H] at [B, M] offsets
         pos = batch["cls_positions"].astype(jnp.int32)
-        hM = jnp.take_along_axis(hidden, pos[..., None], axis=1)  # [B, M, H]
+        if seq_axis is not None:
+            # cls offsets are GLOBAL; hidden is this shard's [B, S_local]
+            # slice.  Each shard gathers the offsets landing in its slice
+            # (clipped gather, masked) and a psum assembles the full
+            # [B, M, H] on every shard — the packed analog of the
+            # shard-0 [CLS] broadcast below, same head-grads-counted-once
+            # contract (the sp loss is gated to seq-shard 0).
+            S_local = hidden.shape[1]
+            off = jax.lax.axis_index(seq_axis) * S_local
+            local = pos - off
+            inb = (local >= 0) & (local < S_local)
+            safe = jnp.clip(local, 0, S_local - 1)
+            hM = jnp.take_along_axis(hidden, safe[..., None], axis=1)
+            hM = jax.lax.psum(
+                hM * inb[..., None].astype(hidden.dtype), seq_axis)
+        else:
+            hM = jnp.take_along_axis(hidden, pos[..., None], axis=1)
         B, M, H = hM.shape
         out = head(params, cfg, hM.reshape(B * M, H), dtype=dtype,
                    drop_rng=None if deterministic else drop_rng)

@@ -35,6 +35,22 @@ import jax.numpy as jnp
 
 NEG_INF = -1e9  # additive mask bias; well inside bf16/f32 range
 
+#: Measured per-(width, segmented) routing crossovers consulted by
+#: ``"auto"`` — the full-step numbers in ``results/longcontext.json``
+#: (v5e, bert-base-long, fwd+bwd+AdamW), re-measured by ``bench.py
+#: --longcontext`` on the chip after kernel changes.  Dense (unsegmented)
+#: long widths measured XLA ahead of the streamed kernel at every width on
+#: v5e, so auto keeps them on XLA even where the static rule would allow
+#: pallas; segmented widths carry no entries — the static
+#: packed-on-TPU-at-tiling-widths rule stands (the block-sparse tile skip
+#: is width-independent upside).  An entry here OVERRIDES the static rule
+#: for auto only; explicit ``--attn_impl pallas``/``xla`` never consults it.
+ROUTING_TABLE = {
+    (512, False): "xla",    # flash 0.66x full-step vs XLA (longcontext.json)
+    (1024, False): "xla",   # 0.73x
+    (2048, False): "xla",   # 0.67x
+}
+
 #: shapes already warned about (once per process per shape, not per trace)
 _FALLBACK_WARNED: set = set()
 
@@ -65,12 +81,35 @@ def resolve_impl(requested: str, *, segmented: bool = False,
 
 
 def routed_impl(requested: str, seq_len: int, *, segmented: bool = False,
-                dropout: bool = False) -> str:
+                dropout: bool = False, backend: Optional[str] = None) -> str:
     """The impl that will actually execute for this (static) configuration
     — the single decision :func:`dot_product_attention`, the trainer's
     ``step_dispatch`` span attr, and the bench JSON all share, so the
-    surfaced impl can never drift from the routed one."""
-    impl = resolve_impl(requested, segmented=segmented)
+    surfaced impl can never drift from the routed one.
+
+    ``"auto"`` first applies the backend-level rule (:func:`resolve_impl`)
+    and then consults the measured per-(width, segmented) crossover table
+    (:data:`ROUTING_TABLE`): a width the chip measured slower on the kernel
+    routes to XLA with a once-per-shape "measured slower" warning —
+    distinguishable from the "does not tile" fallback a pallas request
+    takes below the 128-wide kernel blocks.  ``backend`` overrides the
+    running backend (bench/test reporting from a CPU host)."""
+    impl = resolve_impl(requested, segmented=segmented, backend=backend)
+    if requested == "auto":
+        measured = ROUTING_TABLE.get((int(seq_len), bool(segmented)))
+        if measured == "xla":
+            if impl == "pallas":  # the table OVERRODE the static rule
+                _warn_fallback(requested, seq_len,
+                               "measured slower than XLA at this width "
+                               "(ROUTING_TABLE / results/longcontext.json)")
+            return "xla"
+        if measured == "pallas":
+            # a measured win routes pallas even where the static rule is
+            # conservative (e.g. dense long widths after a kernel change,
+            # re-measured by bench.py --longcontext) — still TPU-only:
+            # the kernel interprets (slowly) everywhere else
+            bk = backend or jax.default_backend()
+            impl = "pallas" if bk == "tpu" else "xla"
     if impl != "pallas":
         return "xla"
     if dropout:
@@ -78,7 +117,9 @@ def routed_impl(requested: str, seq_len: int, *, segmented: bool = False,
     from pdnlp_tpu.ops import flash
 
     if not flash.supported_seq(seq_len):
-        _warn_fallback(requested, seq_len)
+        _warn_fallback(requested, seq_len,
+                       f"does not tile the {flash.BLOCK_Q}-wide kernel "
+                       "blocks")
         return "xla"
     return "pallas"
 
@@ -96,20 +137,19 @@ def routed_impl_cached(requested: str, seq_len: int, *,
                        dropout=dropout)
 
 
-def _warn_fallback(requested: str, seq_len: int) -> None:
-    """Once per process per shape: a pallas-routed attention fell back to
-    XLA because the sequence length does not tile the kernel blocks."""
-    key = ("seq", seq_len)
+def _warn_fallback(requested: str, seq_len: int, reason: str) -> None:
+    """Once per process per shape: a pallas-eligible attention routed to
+    XLA — ``reason`` distinguishes "does not tile" (shape can never run
+    the kernel) from "measured slower" (the crossover table overrode
+    auto's static rule for this width)."""
+    key = ("seq", seq_len, reason[:8])
     if key in _FALLBACK_WARNED:
         return
     _FALLBACK_WARNED.add(key)
-    from pdnlp_tpu.ops import flash
-
-    print(f"[ops.attention] impl={requested!r} routed to pallas but "
-          f"seq_len={seq_len} does not tile the {flash.BLOCK_Q}-wide kernel "
-          "blocks — falling back to XLA attention for this shape "
-          "(widths from --length_buckets under 128 always take this path; "
-          "force --attn_impl xla to silence)", file=sys.stderr)
+    print(f"[ops.attention] impl={requested!r} at seq_len={seq_len}: "
+          f"{reason} — routing to XLA attention for this shape "
+          "(widths from --length_buckets under 128 never tile; "
+          "force --attn_impl xla|pallas to silence)", file=sys.stderr)
 
 
 def dot_product_attention(

@@ -4,19 +4,47 @@ The XLA path (``ops.attention``) materializes the [B, N, S, S] score tensor
 in HBM; at seq 128 XLA fuses it well, but the quadratic HBM traffic is what
 caps long-context training.  This kernel keeps scores in VMEM tiles and
 streams KV blocks through an online softmax (the FlashAttention recurrence),
-so HBM traffic stays linear in S:
+so HBM traffic stays linear in S.
 
-- **forward**: grid over (batch*heads, Q blocks); fori_loop over KV blocks
-  carrying (acc, rowmax m, rowsum l); saves the (m, l) rows for the
-  backward pass.  The rows are saved SEPARATELY, not folded into the usual
-  logsumexp ``L = m + log l``: a fully-masked query row (packed-row padding
-  is segment 0) puts every score at ``-1e9``, where fp32 resolution is
-  ~64 — the ``log l`` term would round away entirely and the backward's
-  recomputed probabilities would come back unnormalized.  ``exp(s - m) / l``
-  is exact there (``s - m`` is an exact 0), matching XLA's softmax VJP.
+**Multi-tile structure** (the long-context shape of the kernel): every
+kernel runs a 3-D grid whose K/V (or, for dKV, Q) tile index is the
+INNERMOST grid dimension, so Pallas's pipeline emitter double-buffers the
+streamed 128-wide K/V tiles against the MXU compute — the single-invocation
+``fori_loop`` this replaced loaded the whole [S, D] K/V into VMEM up front
+(no fetch/compute overlap, VMEM linear in S).  The fp32 accumulators
+(output numerator, running rowmax ``m``, running rowsum ``l``) live in VMEM
+scratch across the inner iterations and are written back exactly once:
+
+- **forward**: grid (B*N, S/128 Q tiles, S/128 KV tiles); saves the (m, l)
+  rows for the backward pass.  The rows are saved SEPARATELY, not folded
+  into the usual logsumexp ``L = m + log l``: a fully-masked query row
+  (packed-row padding is segment 0) puts every score at ``-1e9``, where
+  fp32 resolution is ~64 — the ``log l`` term would round away entirely and
+  the backward's recomputed probabilities would come back unnormalized.
+  ``exp(s - m) / l`` is exact there, matching XLA's softmax VJP.
 - **backward**: two independent kernels (no cross-grid accumulation):
-  dQ gridded over Q blocks, dK/dV gridded over KV blocks, both recomputing
-  probabilities from (m, l) — the standard FlashAttention-2 split.
+  dQ gridded (B*N, Q tiles, KV tiles), dK/dV gridded (B*N, KV tiles,
+  Q tiles), both recomputing probabilities from (m, l) — the standard
+  FlashAttention-2 split.
+
+**Block-sparse tile skip**: every kernel consumes a tiny per-(batch,
+q-tile, k-tile) activity map (linear-in-S to build, ``(S/128)^2`` int32s —
+never the [S, S] bias) and wraps the tile compute in ``pl.when``:
+
+- packed rows (:func:`segment_block_map`): a tile is live iff the q tile's
+  and k tile's nonzero-segment-ID ranges intersect.  Packed rows are
+  block-diagonal, so off-diagonal tiles — the asymptotic majority at
+  512-8k widths — skip their matmuls entirely.  Skipping is EXACT, not
+  approximate: a skipped tile's probabilities are ``exp(raw - 1e9 - m)``,
+  which underflows fp32 to literal 0.0 for any query row with at least one
+  live tile.  A q tile containing padding rows (segment 0) stays fully
+  live — a fully-masked row's output is softmax of the raw scores (both
+  impls' documented semantics), which needs every tile.
+- dense masks (:func:`bias_block_map`): a k tile whose additive bias is
+  uniformly ``-1e9`` (padding beyond the batch's real tokens) is skipped
+  for the whole batch row, unless the row is ALL masked (filler rows keep
+  every tile so the softmax-of-raw semantics hold).  Long padded rows are
+  mostly padding, so the dense path sheds its padding tiles too.
 
 **Segment-native masking** (``segment_ids``): packed rows
 (``data.packing``) need a block-diagonal mask so co-packed examples never
@@ -50,11 +78,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu  # noqa: F401 (TPU lowering)
+from jax.experimental.pallas import tpu as pltpu
 
 BLOCK_Q = 128
 BLOCK_K = 128
 LANES = 128   # minor-dim width of the q-side segment-ID layout
+assert BLOCK_K == LANES  # the lane-broadcast (m, l) scratch relies on it
 NEG_INF = -1e9
 
 
@@ -62,6 +91,17 @@ def _interpret() -> bool:
     """Pallas TPU kernels run via the interpreter on non-TPU backends (CI's
     virtual CPU mesh); compiled Mosaic on real chips."""
     return jax.default_backend() != "tpu"
+
+
+def _compiler_params():
+    """Grid dimension semantics: (batch*head, q-tile) iterate freely; the
+    innermost streamed tile axis is sequential (it owns the scratch
+    accumulators).  Interpret mode ignores the hint."""
+    try:
+        return pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except Exception:  # pragma: no cover — very old pallas without params
+        return None
 
 
 def supported_seq(seq_len: int) -> bool:
@@ -72,6 +112,54 @@ def supported_seq(seq_len: int) -> bool:
 def supported(q: jax.Array) -> bool:
     """Static-shape gate used by ``ops.attention`` (``q``: [B, S, N, D])."""
     return supported_seq(q.shape[1])
+
+
+# ------------------------------------------------------------- block maps
+
+
+def segment_block_map(segment_ids: jax.Array) -> jax.Array:
+    """[B, S] segment IDs -> [B, S/128, S/128] int32 tile-activity map.
+
+    A (q-tile, k-tile) pair is live iff the tiles' nonzero segment-ID
+    ranges intersect (packed segments are contiguous, so the min/max range
+    test is exact for them and merely conservative for any other ID
+    layout), OR the q tile contains padding rows (segment 0) — a
+    fully-masked row's output is softmax of the raw scores, which needs
+    every tile (see the module docstring: skipping is exact only for rows
+    with a live tile).  Linear in S to build, ``(S/128)^2`` int32s per
+    batch row — the [B, 1, S, S] bias never exists anywhere.
+    """
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    B, S = seg.shape
+    qb = seg.reshape(B, S // BLOCK_Q, BLOCK_Q)
+    kb = seg.reshape(B, S // BLOCK_K, BLOCK_K)
+    big = jnp.int32(2 ** 30)
+    qmin = jnp.min(jnp.where(qb > 0, qb, big), -1)   # [B, nq]
+    qmax = jnp.max(qb, -1)                           # padding (0) < any id
+    kmin = jnp.min(jnp.where(kb > 0, kb, big), -1)
+    kmax = jnp.max(kb, -1)
+    has_pad_q = jnp.any(qb == 0, -1)                 # [B, nq]
+    inter = ((qmin[:, :, None] <= kmax[:, None, :])
+             & (kmin[:, None, :] <= qmax[:, :, None]))
+    return (inter | has_pad_q[:, :, None]).astype(jnp.int32)
+
+
+def bias_block_map(bias2: jax.Array, n_q: int) -> jax.Array:
+    """[B, 1, S] additive mask bias -> [B, n_q, S/128] tile-activity map.
+
+    A k tile is dead when its bias is uniformly at the ``-1e9`` floor
+    (padding keys shared by every query row — the bias is per-key).  A
+    batch row whose EVERY key is masked (zero-weight filler rows) keeps
+    all tiles so its softmax-of-raw output matches the XLA path exactly.
+    """
+    B = bias2.shape[0]
+    S = bias2.shape[-1]
+    kb = bias2.reshape(B, S // BLOCK_K, BLOCK_K)
+    act_k = jnp.any(kb > NEG_INF / 2, -1)            # [B, nk]
+    all_masked = ~jnp.any(act_k, -1)                 # [B]
+    act = act_k | all_masked[:, None]
+    return jnp.broadcast_to(act[:, None, :],
+                            (B, n_q, act.shape[-1])).astype(jnp.int32)
 
 
 def _seg_inputs(segment_ids: jax.Array):
@@ -100,261 +188,322 @@ def _seg_bias_block(qs, ks):
 # ---------------------------------------------------------------- forward
 
 
-def _fwd_kernel(*refs, scale, s_len, segmented):
+def _fwd_kernel(*refs, scale, n_k, segmented):
     if segmented:
-        q_ref, k_ref, v_ref, sq_ref, skv_ref, o_ref, m_ref, l_ref = refs
-        qs = sq_ref[0, :, :1]                         # [Bq, 1] int32
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref, act_ref,
+         o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr) = refs
     else:
-        q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref = refs
-    q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
-    nk = s_len // BLOCK_K
+        (q_ref, k_ref, v_ref, bias_ref, act_ref,
+         o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr) = refs
+    ki = pl.program_id(2)
 
-    def body(ki, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(ki * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    @pl.when(act_ref[0, 0, 0] != 0)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale           # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)                   # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if segmented:
-            ks = skv_ref[0, 0, pl.ds(ki * BLOCK_K, BLOCK_K)][None, :]
-            s = s + _seg_bias_block(qs, ks)
+            s = s + _seg_bias_block(sq_ref[0, :, :1], skv_ref[0, 0][None, :])
         else:
-            b = bias_ref[0, 0, pl.ds(ki * BLOCK_K, BLOCK_K)].astype(jnp.float32)
-            s = s + b[None, :]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+        # (m, l) scratch is lane-broadcast [Bq, LANES] (every lane equal),
+        # so s [Bq, BLOCK_K == LANES] composes elementwise with no relayout
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
-        return acc, m_new, l
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
 
-    acc0 = jnp.zeros((BLOCK_Q, q.shape[-1]), jnp.float32)
-    m0 = jnp.full((BLOCK_Q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((BLOCK_Q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    # (m, l) saved separately — see module docstring: folding them into
-    # L = m + log(l) loses log(l) to fp32 rounding on fully-masked rows
-    m_ref[0, 0] = m[:, 0]
-    l_ref[0, 0] = l[:, 0]
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_scr[...]
+        o_ref[0] = (acc_scr[...] / l[:, :1]).astype(o_ref.dtype)
+        # (m, l) saved separately — see module docstring: folding them into
+        # L = m + log(l) loses log(l) to fp32 rounding on fully-masked rows
+        m_ref[0, 0] = m_scr[...][:, 0]
+        l_ref[0, 0] = l[:, 0]
 
 
-def _fwd(q3, k3, v3, mask, scale, n_heads, segmented):
-    """q3/k3/v3: [BN, S, D]; mask: [B,1,S] bias or (seg_kv, seg_q).
-    -> (o3, m[BN, 1, S], l[BN, 1, S]).  Mask operands live at batch
-    granularity and are broadcast over heads via the ``bh // n_heads``
-    index maps — no N-fold HBM copy."""
+def _fwd(q3, k3, v3, mask, active, scale, n_heads, segmented):
+    """q3/k3/v3: [BN, S, D]; mask: [B,1,S] bias or (seg_kv, seg_q);
+    active: [B, nq, nk] tile map.  -> (o3, m[BN, 1, S], l[BN, 1, S]).
+    Mask/activity operands live at batch granularity and are broadcast
+    over heads via the ``bh // n_heads`` index maps — no N-fold HBM copy."""
     BN, S, D = q3.shape
     n = n_heads
-    grid = (BN, S // BLOCK_Q)
-    kernel = functools.partial(_fwd_kernel, scale=scale, s_len=S,
+    nq, nk = S // BLOCK_Q, S // BLOCK_K
+    grid = (BN, nq, nk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, n_k=nk,
                                segmented=segmented)
     if segmented:
         seg_kv, seg_q = mask
         mask_ops = [seg_q, seg_kv]
         mask_specs = [
-            pl.BlockSpec((1, BLOCK_Q, LANES), lambda bh, qi: (bh // n, qi, 0)),
-            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh // n, 0, 0)),
+            pl.BlockSpec((1, BLOCK_Q, LANES),
+                         lambda bh, qi, ki: (bh // n, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K),
+                         lambda bh, qi, ki: (bh // n, 0, ki)),
         ]
     else:
         mask_ops = [mask]
-        mask_specs = [pl.BlockSpec((1, 1, S),
-                                   lambda bh, qi: (bh // n, 0, 0))]
+        mask_specs = [pl.BlockSpec((1, 1, BLOCK_K),
+                                   lambda bh, qi, ki: (bh // n, 0, ki))]
     o3, m, l = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, qi, ki: (bh, ki, 0)),
             *mask_specs,
+            pl.BlockSpec((1, 1, 1), lambda bh, qi, ki: (bh // n, qi, ki)),
         ],
         out_specs=[
-            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi, ki: (bh, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BN, S, D), q3.dtype),
             jax.ShapeDtypeStruct((BN, 1, S), jnp.float32),
             jax.ShapeDtypeStruct((BN, 1, S), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, D), jnp.float32),
+            pltpu.VMEM((BLOCK_Q, LANES), jnp.float32),
+            pltpu.VMEM((BLOCK_Q, LANES), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q3, k3, v3, *mask_ops)
+    )(q3, k3, v3, *mask_ops, active)
     return o3, m, l
 
 
 # --------------------------------------------------------------- backward
 
 
-def _dq_kernel(*refs, scale, segmented):
+def _dq_kernel(*refs, scale, n_k, segmented):
     if segmented:
-        (q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, m_ref, l_ref,
-         Di_ref, dq_ref) = refs
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref, act_ref, do_ref,
+         m_ref, l_ref, Di_ref, dq_ref, dq_scr) = refs
     else:
-        (q_ref, k_ref, v_ref, bias_ref, do_ref, m_ref, l_ref, Di_ref,
-         dq_ref) = refs
-    q = q_ref[0].astype(jnp.float32)                   # [Bq, D]
-    k = k_ref[0].astype(jnp.float32)                   # [S, D]
-    v = v_ref[0].astype(jnp.float32)                   # [S, D]
-    do = do_ref[0].astype(jnp.float32)                 # [Bq, D]
-    m = m_ref[0, 0][:, None]                           # [Bq, 1]
-    l = l_ref[0, 0][:, None]                           # [Bq, 1]
-    Di = Di_ref[0, 0][:, None]                         # [Bq, 1]
-    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    if segmented:
-        s = s + _seg_bias_block(sq_ref[0, :, :1], skv_ref[0, 0][None, :])
-    else:
-        s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
-    p = jnp.exp(s - m) / l                             # [Bq, S]
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - Di)
-    dq_ref[0] = (jnp.dot(ds, k, preferred_element_type=jnp.float32)
-                 * scale).astype(dq_ref.dtype)
+        (q_ref, k_ref, v_ref, bias_ref, act_ref, do_ref,
+         m_ref, l_ref, Di_ref, dq_ref, dq_scr) = refs
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(act_ref[0, 0, 0] != 0)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                   # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)                   # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)                 # [Bq, D]
+        m = m_ref[0, 0][:, None]                           # [Bq, 1]
+        l = l_ref[0, 0][:, None]
+        Di = Di_ref[0, 0][:, None]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if segmented:
+            s = s + _seg_bias_block(sq_ref[0, :, :1], skv_ref[0, 0][None, :])
+        else:
+            s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+        p = jnp.exp(s - m) / l                             # [Bq, Bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - Di)
+        dq_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(*refs, scale, segmented):
+def _dkv_kernel(*refs, scale, n_q, segmented):
     if segmented:
-        (q_ref, k_ref, v_ref, sq_ref, skv_ref, do_ref, m_ref, l_ref,
-         Di_ref, dk_ref, dv_ref) = refs
+        (q_ref, k_ref, v_ref, sq_ref, skv_ref, act_ref, do_ref,
+         m_ref, l_ref, Di_ref, dk_ref, dv_ref, dk_scr, dv_scr) = refs
     else:
-        (q_ref, k_ref, v_ref, bias_ref, do_ref, m_ref, l_ref, Di_ref,
-         dk_ref, dv_ref) = refs
-    q = q_ref[0].astype(jnp.float32)                   # [S, D]
-    k = k_ref[0].astype(jnp.float32)                   # [Bk, D]
-    v = v_ref[0].astype(jnp.float32)                   # [Bk, D]
-    do = do_ref[0].astype(jnp.float32)                 # [S, D]
-    m = m_ref[0, 0][:, None]                           # [S, 1]
-    l = l_ref[0, 0][:, None]                           # [S, 1]
-    Di = Di_ref[0, 0][:, None]                         # [S, 1]
-    s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    if segmented:
-        # q-side IDs over ALL S rows, k-side over this K block
-        s = s + _seg_bias_block(sq_ref[0, :, :1], skv_ref[0, 0][None, :])
-    else:
-        s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]  # this K block
-    p = jnp.exp(s - m) / l                             # [S, Bk]
-    dv_ref[0] = jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - Di)                                 # [S, Bk]
-    dk_ref[0] = (jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale).astype(dk_ref.dtype)
+        (q_ref, k_ref, v_ref, bias_ref, act_ref, do_ref,
+         m_ref, l_ref, Di_ref, dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(act_ref[0, 0, 0] != 0)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                   # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)                   # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)                 # [Bq, D]
+        m = m_ref[0, 0][:, None]                           # [Bq, 1]
+        l = l_ref[0, 0][:, None]
+        Di = Di_ref[0, 0][:, None]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if segmented:
+            s = s + _seg_bias_block(sq_ref[0, :, :1], skv_ref[0, 0][None, :])
+        else:
+            s = s + bias_ref[0, 0].astype(jnp.float32)[None, :]
+        p = jnp.exp(s - m) / l                             # [Bq, Bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - Di)                                 # [Bq, Bk]
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bwd_impl(scale, n_heads, segmented, res, do3):
-    q3, k3, v3, mask, o3, m, l = res
+    q3, k3, v3, mask, active, o3, m, l = res
     BN, S, D = q3.shape
     n = n_heads
+    nq, nk = S // BLOCK_Q, S // BLOCK_K
     Di = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                  axis=-1)[:, None, :]
     if segmented:
         seg_kv, seg_q = mask
-        # dq reads the full k-side row; dkv slices it per K block
-        dq_mask_ops = [seg_q, seg_kv]
+        mask_ops = [seg_q, seg_kv]
         dq_mask_specs = [
-            pl.BlockSpec((1, BLOCK_Q, LANES), lambda bh, qi: (bh // n, qi, 0)),
-            pl.BlockSpec((1, 1, S), lambda bh, qi: (bh // n, 0, 0)),
+            pl.BlockSpec((1, BLOCK_Q, LANES),
+                         lambda bh, qi, ki: (bh // n, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K),
+                         lambda bh, qi, ki: (bh // n, 0, ki)),
         ]
-        dkv_mask_ops = [seg_q, seg_kv]
         dkv_mask_specs = [
-            pl.BlockSpec((1, S, LANES), lambda bh, ki: (bh // n, 0, 0)),
-            pl.BlockSpec((1, 1, BLOCK_K), lambda bh, ki: (bh // n, 0, ki)),
+            pl.BlockSpec((1, BLOCK_Q, LANES),
+                         lambda bh, ki, qi: (bh // n, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K),
+                         lambda bh, ki, qi: (bh // n, 0, ki)),
         ]
     else:
-        dq_mask_ops = dkv_mask_ops = [mask]
-        dq_mask_specs = [pl.BlockSpec((1, 1, S),
-                                      lambda bh, qi: (bh // n, 0, 0))]
+        mask_ops = [mask]
+        dq_mask_specs = [pl.BlockSpec((1, 1, BLOCK_K),
+                                      lambda bh, qi, ki: (bh // n, 0, ki))]
         dkv_mask_specs = [pl.BlockSpec((1, 1, BLOCK_K),
-                                       lambda bh, ki: (bh // n, 0, ki))]
+                                       lambda bh, ki, qi: (bh // n, 0, ki))]
 
     dq3 = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, segmented=segmented),
-        grid=(BN, S // BLOCK_Q),
+        functools.partial(_dq_kernel, scale=scale, n_k=nk,
+                          segmented=segmented),
+        grid=(BN, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, qi, ki: (bh, ki, 0)),
             *dq_mask_specs,
-            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, 1), lambda bh, qi, ki: (bh // n, qi, ki)),
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, qi, ki: (bh, 0, qi)),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, BLOCK_Q, D),
+                               lambda bh, qi, ki: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BN, S, D), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((BLOCK_Q, D), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q3, k3, v3, *dq_mask_ops, do3, m, l, Di)
+    )(q3, k3, v3, *mask_ops, active, do3, m, l, Di)
 
     dk3, dv3 = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, segmented=segmented),
-        grid=(BN, S // BLOCK_K),
+        functools.partial(_dkv_kernel, scale=scale, n_q=nq,
+                          segmented=segmented),
+        grid=(BN, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki, qi: (bh, ki, 0)),
             *dkv_mask_specs,
-            pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, S), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, S), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, S), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, 1), lambda bh, ki, qi: (bh // n, qi, ki)),
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda bh, ki, qi: (bh, 0, qi)),
         ],
         out_specs=[
-            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, ki, qi: (bh, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BN, S, D), k3.dtype),
             jax.ShapeDtypeStruct((BN, S, D), v3.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_K, D), jnp.float32),
+            pltpu.VMEM((BLOCK_K, D), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q3, k3, v3, *dkv_mask_ops, do3, m, l, Di)
+    )(q3, k3, v3, *mask_ops, active, do3, m, l, Di)
     return dq3, dk3, dv3
 
 
 # ---------------------------------------------------- custom-VJP wrappers
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash3(q3, k3, v3, bias2, scale, n_heads):
-    """bias2: [B, 1, S] additive, broadcast over heads via the index map."""
-    return _fwd(q3, k3, v3, bias2, scale, n_heads, segmented=False)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash3(q3, k3, v3, bias2, active, scale, n_heads):
+    """bias2: [B, 1, S] additive, broadcast over heads via the index map;
+    active: [B, nq, nk] tile map (``bias_block_map``)."""
+    return _fwd(q3, k3, v3, bias2, active, scale, n_heads,
+                segmented=False)[0]
 
 
-def _flash3_fwd(q3, k3, v3, bias2, scale, n_heads):
-    o3, m, l = _fwd(q3, k3, v3, bias2, scale, n_heads, segmented=False)
-    return o3, (q3, k3, v3, bias2, o3, m, l)
+def _flash3_fwd(q3, k3, v3, bias2, active, scale, n_heads):
+    o3, m, l = _fwd(q3, k3, v3, bias2, active, scale, n_heads,
+                    segmented=False)
+    return o3, (q3, k3, v3, bias2, active, o3, m, l)
 
 
 def _flash3_bwd(scale, n_heads, res, do3):
-    return _bwd_impl(scale, n_heads, False, res, do3) + (None,)
+    return _bwd_impl(scale, n_heads, False, res, do3) + (None, None)
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def _flash3_seg(q3, k3, v3, seg_kv, seg_q, scale, n_heads):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _flash3_seg(q3, k3, v3, seg_kv, seg_q, active, scale, n_heads):
     """Segment-native variant: the block-diagonal mask is computed inside
-    the kernels from (seg_kv [B,1,S], seg_q [B,S,LANES]) int32 IDs."""
-    return _fwd(q3, k3, v3, (seg_kv, seg_q), scale, n_heads,
+    the kernels from (seg_kv [B,1,S], seg_q [B,S,LANES]) int32 IDs, and
+    ``active`` (``segment_block_map``) skips the dead off-diagonal tiles."""
+    return _fwd(q3, k3, v3, (seg_kv, seg_q), active, scale, n_heads,
                 segmented=True)[0]
 
 
-def _flash3_seg_fwd(q3, k3, v3, seg_kv, seg_q, scale, n_heads):
-    o3, m, l = _fwd(q3, k3, v3, (seg_kv, seg_q), scale, n_heads,
+def _flash3_seg_fwd(q3, k3, v3, seg_kv, seg_q, active, scale, n_heads):
+    o3, m, l = _fwd(q3, k3, v3, (seg_kv, seg_q), active, scale, n_heads,
                     segmented=True)
-    return o3, (q3, k3, v3, (seg_kv, seg_q), o3, m, l)
+    return o3, (q3, k3, v3, (seg_kv, seg_q), active, o3, m, l)
 
 
 def _flash3_seg_bwd(scale, n_heads, res, do3):
-    return _bwd_impl(scale, n_heads, True, res, do3) + (None, None)
+    return _bwd_impl(scale, n_heads, True, res, do3) + (None, None, None)
 
 
 _flash3_seg.defvjp(_flash3_seg_fwd, _flash3_seg_bwd)
@@ -373,8 +522,10 @@ def flash_attention(
     ``segment_ids`` selects the segment-native packed path: the
     block-diagonal mask (``data.packing.segment_bias`` semantics — attend
     iff query and key share a nonzero segment) is derived in-kernel from
-    the IDs, so the [B, 1, S, S] bias never materializes in HBM.  Mutually
-    exclusive with ``bias`` — padding is already segment 0.
+    the IDs, so the [B, 1, S, S] bias never materializes in HBM, and the
+    off-diagonal tiles the mask kills are skipped outright
+    (``segment_block_map``).  Mutually exclusive with ``bias`` — padding
+    is already segment 0.
     """
     B, S, N, D = q.shape
     scale = D ** -0.5
@@ -387,11 +538,14 @@ def flash_attention(
             raise ValueError("pass bias OR segment_ids, not both — padding "
                              "is segment 0 and needs no separate mask")
         seg_kv, seg_q = _seg_inputs(segment_ids)
-        o3 = _flash3_seg(to3(q), to3(k), to3(v), seg_kv, seg_q, scale, N)
+        active = segment_block_map(segment_ids)
+        o3 = _flash3_seg(to3(q), to3(k), to3(v), seg_kv, seg_q, active,
+                         scale, N)
         return o3.reshape(B, N, S, D).transpose(0, 2, 1, 3)
     if bias is None:
         bias2 = jnp.zeros((B, 1, S), jnp.float32)
     else:
         bias2 = bias.reshape(B, 1, S).astype(jnp.float32)
-    o3 = _flash3(to3(q), to3(k), to3(v), bias2, scale, N)
+    active = bias_block_map(bias2, S // BLOCK_Q)
+    o3 = _flash3(to3(q), to3(k), to3(v), bias2, active, scale, N)
     return o3.reshape(B, N, S, D).transpose(0, 2, 1, 3)

@@ -27,7 +27,8 @@ from jax import lax
 from pdnlp_tpu.ops.attention import NEG_INF
 
 
-def _block_attn(q, k, v, bias, drop_key=None, keep=1.0):
+def _block_attn(q, k, v, bias, drop_key=None, keep=1.0,
+                q_seg=None, k_seg=None):
     """One blockwise partial attention: returns (numerator [B,Sq,N,D],
     rowmax m, rowsum l) in fp32 — the merge state of the online softmax.
 
@@ -35,12 +36,25 @@ def _block_attn(q, k, v, bias, drop_key=None, keep=1.0):
     Bernoulli mask multiplies the *numerator* term only (scaled 1/keep),
     while the rowsum ``l`` accumulates the undropped probabilities — so the
     final ``acc / l`` equals ``dropout(softmax(s)) @ v`` exactly, the same
-    semantics as the dense path's ``dot_product_attention`` dropout."""
+    semantics as the dense path's ``dot_product_attention`` dropout.
+
+    ``q_seg``/``k_seg`` ([B, Sq]/[B, Sk] packed segment IDs, 0 = padding)
+    select the PACKED layout: this hop's block-diagonal mask — attend iff
+    the local query and the visiting key share a nonzero segment — is
+    computed here from the two linear-in-shard ID vectors.  The mask block
+    is [B, Sq_local, Sk_local], quadratic in the SHARD width only (the
+    same order as the score tensor ``s`` this formulation already holds);
+    the global [B, 1, S, S] ``segment_bias`` never exists on any device.
+    """
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)[:, None, None, :]
+    if q_seg is not None:
+        same = (q_seg[:, :, None] == k_seg[:, None, :]) & \
+            (q_seg[:, :, None] > 0)
+        s = s + jnp.where(same, 0.0, NEG_INF)[:, None, :, :]
     m = jnp.max(s, axis=-1, keepdims=True)              # [B,N,Sq,1]
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -59,10 +73,19 @@ def ring_attention(
     axis_name: str = "seq",
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,  # [B, S_local], 0 = padding
 ) -> jax.Array:
     """Full-sequence attention for a sequence-sharded layout (must run
     inside ``shard_map`` over ``axis_name``).  Output is this shard's rows,
     exactly equal to single-device attention over the gathered sequence.
+
+    ``segment_ids`` selects the PACKED layout (mutually exclusive with
+    ``bias_local`` — padding is segment 0): the local shard's IDs stay
+    put as the query-side mask input while a copy rotates around the ring
+    alongside K/V, and each hop derives its block-diagonal mask from the
+    (local, visiting) ID pair — so sequences that span devices compose
+    with packing instead of refusing it, and the only mask tensors that
+    ever exist are per-hop shard-local blocks (see ``_block_attn``).
 
     ``dropout_rate``/``dropout_rng`` enable attention-probability dropout
     (the reference BERT's ``attention_probs_dropout_prob``): every (q, kv)
@@ -72,9 +95,21 @@ def ring_attention(
     Masks depend on the shard layout, so dropped outputs don't match the
     single-device XLA path draw-for-draw (same as any two attention
     backends); the *distribution* is identical (``tests/test_sp.py``)."""
-    n = lax.axis_size(axis_name)
-    if bias_local is None:
-        bias_local = jnp.zeros(q.shape[:2], jnp.float32)
+    from pdnlp_tpu.parallel.compat import axis_size
+
+    n = axis_size(axis_name)
+    segmented = segment_ids is not None
+    if segmented:
+        if bias_local is not None:
+            raise ValueError("pass bias_local OR segment_ids, not both — "
+                             "packed padding is segment 0 and needs no "
+                             "separate mask")
+        q_seg = segment_ids.astype(jnp.int32)
+        extra = q_seg                    # the k-side IDs ride the ring
+    else:
+        q_seg = None
+        extra = (bias_local if bias_local is not None
+                 else jnp.zeros(q.shape[:2], jnp.float32))
 
     dropping = dropout_rate > 0.0 and dropout_rng is not None
     keep = 1.0 - dropout_rate
@@ -84,6 +119,12 @@ def ring_attention(
     def blk_key(i):
         return jax.random.fold_in(base_key, i) if dropping else None
 
+    def block(k_blk, v_blk, x_blk, key):
+        if segmented:
+            return _block_attn(q, k_blk, v_blk, None, key, keep,
+                               q_seg=q_seg, k_seg=x_blk)
+        return _block_attn(q, k_blk, v_blk, x_blk, key, keep)
+
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(i, carry):
@@ -91,21 +132,20 @@ def ring_attention(
         # rotate first, so exactly n-1 permutes happen across the loop (the
         # local block was consumed before the loop); the transfer overlaps
         # with this step's compute under XLA scheduling
-        k_blk, v_blk, b_blk = jax.tree_util.tree_map(
+        k_blk, v_blk, x_blk = jax.tree_util.tree_map(
             lambda t: lax.ppermute(t, axis_name, perm), kv)
-        num, m_blk, l_blk = _block_attn(q, k_blk, v_blk, b_blk,
-                                        blk_key(i), keep)
+        num, m_blk, l_blk = block(k_blk, v_blk, x_blk, blk_key(i))
         m_new = jnp.maximum(m, m_blk)
         alpha = jnp.exp(m - m_new)                  # rescale old accumulator
         beta = jnp.exp(m_blk - m_new)               # rescale new block
         l = l * alpha + l_blk * beta
         # acc holds [B,Sq,N,D]; alpha/beta are [B,N,Sq,1] -> move axes
         acc = acc * alpha.transpose(0, 2, 1, 3) + num * beta.transpose(0, 2, 1, 3)
-        return acc, m_new, l, (k_blk, v_blk, b_blk)
+        return acc, m_new, l, (k_blk, v_blk, x_blk)
 
     # step 0: this shard's own KV block, no communication
-    acc, m, l = _block_attn(q, k, v, bias_local, blk_key(0), keep)
+    acc, m, l = block(k, v, extra, blk_key(0))
     acc, m, l, _ = lax.fori_loop(
-        1, n, step, (acc, m, l, (k, v, bias_local)), unroll=True)
+        1, n, step, (acc, m, l, (k, v, extra)), unroll=True)
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1, 3)
     return out.astype(q.dtype)

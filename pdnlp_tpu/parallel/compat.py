@@ -1,0 +1,40 @@
+"""Version-portable ``shard_map`` — one import site for the whole tree.
+
+``jax.shard_map`` (with ``check_vma``) landed after jax 0.4.x; the 0.4.37
+this image ships only has ``jax.experimental.shard_map.shard_map`` (with the
+older ``check_rep`` spelling of the same knob).  Every shard_map call in the
+repo (``parallel.sp``/``execution``/``pp``, the sp tests, the longcontext
+smoke) routes through :func:`shard_map` here, so the sequence-parallel and
+explicit-collectives paths run on BOTH jax generations instead of dying with
+``AttributeError: module 'jax' has no attribute 'shard_map'`` on this image
+(the seed's test_sp/test_parallel failure mode).
+"""
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+
+else:  # jax <= 0.4.x: the experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a mapped mesh axis, from inside ``shard_map``.
+
+    ``jax.lax.axis_size`` post-dates this image's jax; the 0.4.x spelling
+    is the core axis frame (same static int, resolved at trace time —
+    0.4.37's ``axis_frame`` returns the size directly, earlier cores a
+    frame object carrying it)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return getattr(frame, "size", frame)

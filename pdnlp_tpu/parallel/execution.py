@@ -27,6 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from pdnlp_tpu.models import BertConfig, bert, get_config
 from pdnlp_tpu.models.config import args_overrides
 from pdnlp_tpu.parallel import collectives
+from pdnlp_tpu.parallel.compat import shard_map
 from pdnlp_tpu.parallel.mesh import DATA_AXIS
 from pdnlp_tpu.parallel.sharding import batch_sharding, replicated, state_shardings
 from pdnlp_tpu.train.optim import build_optimizer
@@ -251,7 +252,7 @@ def make_shardmap_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
         return new_state, {"loss": loss, "accuracy": acc}
 
     batch_specs = P(DATA_AXIS)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(), batch_specs),
         out_specs=(P(), P()),

@@ -43,6 +43,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pdnlp_tpu.parallel.compat import shard_map
 from pdnlp_tpu.models import bert
 from pdnlp_tpu.models.config import BertConfig
 from pdnlp_tpu.parallel.mesh import DATA_AXIS
@@ -293,7 +294,7 @@ def make_pp_train_step(cfg: BertConfig, tx, args, mesh: Mesh,
         return new_state, {"loss": loss, "accuracy": correct / gw}
 
     return _lazy_jit(lambda state: jax.jit(
-        jax.shard_map(
+        shard_map(
             per_device, mesh=mesh,
             in_specs=(pp_specs(state), batch_spec),
             out_specs=(pp_specs(state), P()),
@@ -338,7 +339,7 @@ def make_pp_eval_step(cfg: BertConfig, args, mesh: Mesh, n_micro: int = 4):
 
     out_specs = {"loss_sum": P(), "weight": P(), "correct": P(),
                  "pred": batch_spec, "label": batch_spec, "ew": batch_spec}
-    return _lazy_jit(lambda params: jax.jit(jax.shard_map(
+    return _lazy_jit(lambda params: jax.jit(shard_map(
         per_device, mesh=mesh,
         in_specs=(pp_specs(params), batch_spec),
         out_specs=out_specs,

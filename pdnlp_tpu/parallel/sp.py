@@ -29,10 +29,39 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pdnlp_tpu.models import BertConfig, bert
+from pdnlp_tpu.parallel.compat import shard_map
 from pdnlp_tpu.train.precision import resolve_dtype
 from pdnlp_tpu.train.steps import State, weighted_ce
 
 DATA, SEQ = "data", "seq"
+
+#: [B, S] per-TOKEN channels shard along the sequence axis; everything
+#: else — flat [B] labels and the packed rows' per-SEGMENT [B, M] channels
+#: (``cls_positions``/``label``/``example_weight``, whose second dim is
+#: the segment slot count, not the sequence) — shards over data only.
+#: One definition shared by batch placement and the step in_specs, so a
+#: packed channel can never be sharded one way on upload and another in
+#: the program.
+TOKEN_KEYS = ("input_ids", "attention_mask", "token_type_ids",
+              "segment_ids", "position_ids")
+
+
+def sp_spec(key: str, val) -> P:
+    """The PartitionSpec for one batch channel on the (data, seq) mesh."""
+    return P(DATA, SEQ) if (getattr(val, "ndim", 0) == 2
+                            and key in TOKEN_KEYS) else P(DATA)
+
+
+def _flat_ce(logits, labels, weights, smoothing: float = 0.0):
+    """``weighted_ce`` over packed ([B, M, C] / [B, M]) or flat inputs —
+    per-segment outputs flatten to the per-example stream exactly as
+    ``train.steps.build_train_step`` does, so sp's packed loss IS the
+    single-device packed loss."""
+    if logits.ndim == 3:
+        logits = logits.reshape(-1, logits.shape[-1])
+        labels = labels.reshape(-1)
+        weights = weights.reshape(-1)
+    return weighted_ce(logits, labels, weights, smoothing=smoothing), weights
 
 
 def make_sp_batch(mesh: Mesh) -> Callable[[Dict], Dict[str, jax.Array]]:
@@ -54,8 +83,7 @@ def make_sp_batch(mesh: Mesh) -> Callable[[Dict], Dict[str, jax.Array]]:
     def put(batch: Dict) -> Dict[str, jax.Array]:
         out = {}
         for key, val in batch.items():
-            spec = P(DATA, SEQ) if val.ndim == 2 else P(DATA)
-            sh = NamedSharding(mesh, spec)
+            sh = NamedSharding(mesh, sp_spec(key, val))
             if seq_spans_processes:
                 out[key] = jax.make_array_from_callback(
                     val.shape, sh, lambda idx, v=val: v[idx])
@@ -84,15 +112,15 @@ def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
         logits = bert.classify(params, cfg, batch, dtype=dtype,
                                deterministic=False, rng=rng, remat=remat,
                                seq_axis=SEQ, unroll=unroll)
-        loss, correct, objective = weighted_ce(logits, batch["label"],
-                                               batch["example_weight"],
-                                               smoothing=smoothing)
+        (loss, correct, objective), w = _flat_ce(
+            logits, batch["label"], batch["example_weight"],
+            smoothing=smoothing)
         # gate to seq-shard 0: head grads counted once; encoder grads flow
         # to every shard through the psum backward (see module docstring).
         # objective (smoothed) is differentiated; bare CE is reported.
         on0 = (jax.lax.axis_index(SEQ) == 0).astype(loss.dtype)
         return objective * on0, (loss * on0, correct * on0,
-                                 batch["example_weight"].sum() * on0)
+                                 w.sum() * on0)
 
     def per_device(state: State, batch) -> Tuple[State, Dict[str, jax.Array]]:
         rng = jax.random.fold_in(state["rng"], state["step"])
@@ -123,11 +151,10 @@ def make_sp_train_step(cfg: BertConfig, tx, args, mesh: Mesh):
         return new_state, {"loss": loss, "accuracy": acc}
 
     def specs_for(batch):
-        return {k: P(DATA, SEQ) if v.ndim == 2 else P(DATA)
-                for k, v in batch.items()}
+        return {k: sp_spec(k, v) for k, v in batch.items()}
 
     def compile_step(example_batch):
-        mapped = jax.shard_map(
+        mapped = shard_map(
             per_device, mesh=mesh,
             in_specs=(P(), specs_for(example_batch)),
             out_specs=(P(), P()),
@@ -150,8 +177,8 @@ def make_sp_eval_step(cfg: BertConfig, args, mesh: Mesh):
         logits = bert.classify(params, cfg, batch, dtype=dtype,
                                deterministic=True, seq_axis=SEQ,
                                unroll=unroll)
-        w = batch["example_weight"]
-        loss, correct, _ = weighted_ce(logits, batch["label"], w)
+        (loss, correct, _), w = _flat_ce(logits, batch["label"],
+                                         batch["example_weight"])
         wsum = w.sum()
         out = {
             "loss_sum": jax.lax.psum(loss * wsum, DATA),
@@ -164,11 +191,10 @@ def make_sp_eval_step(cfg: BertConfig, args, mesh: Mesh):
         return out
 
     def specs_for(batch):
-        return {k: P(DATA, SEQ) if v.ndim == 2 else P(DATA)
-                for k, v in batch.items()}
+        return {k: sp_spec(k, v) for k, v in batch.items()}
 
     def compile_step(example_batch):
-        mapped = jax.shard_map(
+        mapped = shard_map(
             per_device, mesh=mesh,
             in_specs=(P(), specs_for(example_batch)),
             out_specs=P(),

@@ -34,7 +34,18 @@ batches.  The batcher bridges the two:
   urgent close the earliest rows and anything that does not fit waits.
   ``auto`` (default) packs only where the segment-native pallas kernel
   routes; ``off`` keeps per-bucket padding (also the permanent path for
-  the router's hedged duplicates).
+  the router's hedged duplicates);
+- **chunked prefill** (``long_widths``, ``--serve_long_widths``): a
+  request longer than the pack width routes to a per-width LONG packed
+  queue and executes as ONE segment of a ``[flush_tokens/w, w]`` packed
+  batch — exact whole-request scoring (positions restart per segment,
+  attention masked to the request), sized so every long flush costs
+  ~the same token budget as a short flush.  Long traffic is consumed in
+  those chunks, interleaved BEHIND short flushes (shorts always go
+  first; an overdue long — 2x the age bound — takes one chunk slot),
+  so one long request never head-of-line-blocks the packed short-query
+  traffic; admission is already token-unit, so long requests simply
+  cost more of the shared pool.
 
 One worker thread owns the engine (JAX dispatch is not thread-safe-by-
 contract here, and a single dispatcher keeps the device busy without lock
@@ -230,6 +241,12 @@ class _PackedBatch:
         return int(self.arrays["input_ids"].size)
 
     @property
+    def width(self) -> int:
+        """The batch's packed row width (the pack width for short flushes,
+        a ``long_widths`` entry for chunked-prefill flushes)."""
+        return int(self.arrays["input_ids"].shape[1])
+
+    @property
     def fill(self) -> float:
         return self.tokens / float(self.slots or 1)
 
@@ -353,6 +370,7 @@ class DynamicBatcher:
         default_deadline_ms: Optional[float] = None,
         serve_pack: str = "auto",
         pack_max_segments: int = 16,
+        long_widths: Sequence[int] = (),
     ):
         self.engine = engine
         self.buckets = usable_buckets(buckets, engine.args.max_seq_len)
@@ -376,9 +394,57 @@ class DynamicBatcher:
         self.pack_segments = int(pack_max_segments)
         self.flush_tokens = self.pack_rows * self.pack_width
         self.max_queue_tokens = self.max_queue * self.pack_width
+        # chunked prefill (``long_widths``): a request longer than the pack
+        # width routes to a per-width LONG packed queue and executes as one
+        # segment of a [rows_w, w] packed batch — exact whole-request
+        # scoring at width w (positions restart per segment, attention
+        # masked to the request) — where rows_w sizes every long flush to
+        # ~the SAME token budget as a short flush (flush_tokens / w rows).
+        # Long traffic is therefore consumed in short-flush-sized chunks
+        # that interleave with the packed short-query flushes instead of
+        # head-of-line-blocking them; admission already charges tokens, so
+        # a long request simply costs more of the shared token pool.
+        self.long_widths = tuple(sorted({int(w) for w in long_widths}))
+        self.long_rows: Dict[int, int] = {}
+        self.long_segments: Dict[int, int] = {}
+        if self.long_widths:
+            from pdnlp_tpu.data.packing import segment_cap
+
+            if not self.packed:
+                raise ValueError(
+                    "chunked prefill (long_widths) rides the packed path — "
+                    "it needs --serve_pack to resolve on for the pack "
+                    "width, got the padded per-bucket path")
+            for w in self.long_widths:
+                if w <= self.pack_width or w % 128:
+                    raise ValueError(
+                        f"long width {w} must exceed the {self.pack_width}-"
+                        "token pack width and tile the 128-wide kernel "
+                        "blocks")
+                if w > engine.cfg.max_position:
+                    raise ValueError(
+                        f"long width {w} exceeds {engine.args.model}'s "
+                        f"{engine.cfg.max_position}-position table — a "
+                        "long request is ONE segment, so its positions "
+                        "span the full width and would gather garbage "
+                        "embeddings past the table.  Use a long-position "
+                        "model (--model bert-base-long, 2048 positions) "
+                        "or drop the width")
+                self.long_rows[w] = engine.pad_rows(
+                    max(1, self.flush_tokens // w))
+                self.long_segments[w] = segment_cap(w, self.pack_segments,
+                                                    self.pack_width)
         self.metrics: ServeMetrics = engine.metrics
         self._queues: Dict[int, List[_Request]] = {b: [] for b in self.buckets}
         self._pack_queue: List[_Request] = []
+        self._long_queues: Dict[int, List[_Request]] = {
+            w: [] for w in self.long_widths}
+        # O(1) per-queue token tallies for the flush decision (summing the
+        # queue request-by-request under the lock would charge every worker
+        # wake O(queued) exactly at saturation); keys: "pack" + each long
+        # width.  _pending_tokens stays the ADMISSION total across them.
+        self._queue_tokens: Dict = {"pack": 0,
+                                    **{w: 0 for w in self.long_widths}}
         self._pending = 0
         self._pending_tokens = 0
         self._lock = threading.Lock()
@@ -409,11 +475,13 @@ class DynamicBatcher:
         self._worker.join(timeout=10)
         self._worker = None
         with self._lock:  # fail anything still queued (stop(drain=False))
-            leftovers = [r for q in self._queues.values() for r in q] \
-                + list(self._pack_queue)
+            leftovers = [r for q in self._all_queues() for r in q]
             for q in self._queues.values():
                 q.clear()
             self._pack_queue = []
+            self._long_queues = {w: [] for w in self.long_widths}
+            self._queue_tokens = {"pack": 0,
+                                  **{w: 0 for w in self.long_widths}}
             self._pending = 0
             self._pending_tokens = 0
             self.metrics.queue_depth.set(0)
@@ -430,17 +498,29 @@ class DynamicBatcher:
         self.stop()
 
     # ------------------------------------------------------------- submit
+    def _all_queues(self) -> List[List[_Request]]:
+        """Every live queue (bucket + packed + long), for sweeps."""
+        return (list(self._queues.values()) + [self._pack_queue]
+                + [self._long_queues[w] for w in self.long_widths])
+
+    @property
+    def max_request_tokens(self) -> int:
+        """The truncation bound a submitted request gets: the largest
+        long width under chunked prefill, else the largest bucket."""
+        return (self.long_widths[-1] if (self.long_widths and self.packed)
+                else self.buckets[-1])
+
     def submit(self, text: str,
                deadline_ms: Optional[float] = None) -> _Request:
         """Enqueue one text; returns a future-like whose ``result()`` is the
         logits row.  Raises :class:`QueueFullError` at capacity (the
         backpressure contract: callers retry or shed).
 
-        Encoding truncates to the LARGEST bucket, not ``max_seq_len`` — a
-        bucket list topping out below the model's padded length is a valid
-        config, and a row no bucket covers would otherwise fail its whole
-        batch at execute time."""
-        ids = self.engine.tokenizer.encode_ids(text, self.buckets[-1])
+        Encoding truncates to the LARGEST width this batcher can serve —
+        the top long width under chunked prefill, else the largest bucket
+        (a row no width covers would otherwise fail its whole batch at
+        execute time)."""
+        ids = self.engine.tokenizer.encode_ids(text, self.max_request_tokens)
         return self.submit_ids(ids, deadline_ms=deadline_ms)
 
     def submit_ids(self, ids: List[int],
@@ -450,18 +530,19 @@ class DynamicBatcher:
             # corrupt a packed batch (phantom segment aliasing a
             # neighbor's [CLS] gather) — reject at the door, loudly
             raise ValueError("empty request: submit at least one token id")
-        if len(ids) > self.buckets[-1]:
+        if len(ids) > self.max_request_tokens:
             # pre-encoded rows get a plain tail truncation (only submit()'s
             # text path knows the [CLS]/[SEP] framing to preserve) — a row
-            # that cannot fit any bucket must never reach a batch, where
-            # its shape error would poison every co-batched request
-            ids = list(ids)[: self.buckets[-1]]
+            # that cannot fit any served width must never reach a batch,
+            # where its shape error would poison every co-batched request
+            ids = list(ids)[: self.max_request_tokens]
         deadline_ms = deadline_ms if deadline_ms is not None \
             else self.default_deadline_ms
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         req = _Request(ids, pick_bucket(len(ids), self.buckets), deadline)
         tr = self.engine.tracer
+        long_w = None  # set by the packed branch when the request is long
         with self._lock:
             if self._stop or self._worker is None:
                 raise RuntimeError("batcher is not running (call start())")
@@ -475,8 +556,18 @@ class DynamicBatcher:
                     raise QueueFullError(
                         f"queue full ({self._pending_tokens}"
                         f"/{self.max_queue_tokens} tokens)")
-                self._pack_queue.append(req)
+                if self.long_widths and len(ids) > self.pack_width:
+                    # chunked prefill: smallest long width covering the
+                    # request; same shared token pool as the short queue
+                    long_w = next(w for w in self.long_widths
+                                  if len(ids) <= w)
+                    req.bucket = long_w
+                    self._long_queues[long_w].append(req)
+                else:
+                    long_w = None
+                    self._pack_queue.append(req)
                 self._pending_tokens += len(ids)
+                self._queue_tokens[long_w or "pack"] += len(ids)
                 self.metrics.queue_tokens.set(self._pending_tokens)
             else:
                 if self._pending >= self.max_queue:
@@ -497,27 +588,48 @@ class DynamicBatcher:
                        **({} if deadline_ms is None
                           else {"deadline_ms": float(deadline_ms)}),
                        **({"packed": True} if self.packed
-                          else {"bucket": req.bucket}))
+                          else {"bucket": req.bucket}),
+                       **({"long_width": long_w}
+                          if self.packed and long_w else {}))
             self._wake.notify()
         return req
 
     # ------------------------------------------------------------- worker
     def _take_flushable(self):
         """Under the lock: pop a flushable batch or None — a full (or aged)
-        bucket on the padded path, a token-budget-full (or aged) packed
-        batch on the packed path."""
+        bucket on the padded path; on the packed path the priority ladder
+        over the short token queue and the chunked-prefill long queues:
+
+        1. OVERDUE long flush (oldest long request waited >= 2x
+           ``max_wait_ms``) — the anti-starvation valve: it outranks even
+           a full short flush, so sustained short saturation cannot park
+           a long request forever, and it costs the short traffic one
+           chunk (a long flush is sized to ~one short flush's tokens);
+        2. short packed flush: a full token budget queued (throughput) or
+           the oldest short aged out (latency) — shorts otherwise always
+           go first, which is what holds the short-query p99 under mixed
+           long/short storms;
+        3. full long chunk (ascending width);
+        4. aged long flush (>= ``max_wait_ms``).
+        """
         now = time.monotonic()
         # expired-deadline requests leave their queue before batch selection
         # (their slot should not hold a flush back or ride a batch)
         expired: List[_Request] = []
-        for q in list(self._queues.values()) + [self._pack_queue]:
+        for key, q in ([(None, b) for b in self._queues.values()]
+                       + [("pack", self._pack_queue)]
+                       + list(self._long_queues.items())):
             keep = []
+            dropped = 0
             for r in q:
                 if r.deadline is not None and now >= r.deadline:
                     expired.append(r)
+                    dropped += len(r.ids)
                 else:
                     keep.append(r)
             q[:] = keep
+            if key is not None and dropped:
+                self._queue_tokens[key] -= dropped
         if expired:
             self._pending -= len(expired)
             if self.packed:  # tokens are only accounted on the packed path
@@ -530,15 +642,28 @@ class DynamicBatcher:
                         "deadline passed while queued")):
                     record_hop(self.engine.tracer, r.rid, "deadline")
         if self.packed:
-            # token-budget flush: a full batch worth of REAL tokens queued
-            # (throughput), else the oldest request aged out (latency)
+            oldest_long = [(min(r.submitted for r in q), w)
+                           for w, q in self._long_queues.items() if q]
+            if oldest_long:  # 1. overdue long outranks full shorts
+                oldest, w = min(oldest_long)
+                if (now - oldest) * 1e3 >= 2 * self.max_wait_ms:
+                    return self._long_pop(w, now)
+            # 2. token-budget flush: a full batch worth of REAL tokens
+            # queued (throughput), else the oldest request aged (latency)
             q = self._pack_queue
-            if not q:
-                return None
-            if self._pending_tokens >= self.flush_tokens \
-                    or (now - min(r.submitted for r in q)) * 1e3 \
-                    >= self.max_wait_ms:
-                return self._pack_pop(now)
+            if q:
+                if self._queue_tokens["pack"] >= self.flush_tokens \
+                        or (now - min(r.submitted for r in q)) * 1e3 \
+                        >= self.max_wait_ms:
+                    return self._pack_pop(now)
+            for w in self.long_widths:  # 3. full long chunk
+                if self._long_queues[w] and self._queue_tokens[w] \
+                        >= self.long_rows[w] * w:
+                    return self._long_pop(w, now)
+            if oldest_long:  # 4. aged long
+                oldest, w = min(oldest_long)
+                if (now - oldest) * 1e3 >= self.max_wait_ms:
+                    return self._long_pop(w, now)
             return None
         # full bucket first (throughput); else the most-overdue aged bucket
         for b, q in self._queues.items():
@@ -557,16 +682,32 @@ class DynamicBatcher:
         Holding the lock here is bounded work — the single-replica queue
         is capped at ``max_queue_tokens`` and only submitters contend (the
         router's multi-worker path packs OUTSIDE its pool-global lock)."""
+        pb, self._pack_queue = self._form_pop(
+            "pack", self._pack_queue, now, self.pack_width, self.pack_rows,
+            self.pack_segments)
+        return pb
+
+    def _long_pop(self, width: int, now: float) -> _PackedBatch:
+        """One chunked-prefill flush: the width's queue bin-packs into a
+        ``[long_rows[w], w]`` batch — the same token budget as a short
+        flush, so it interleaves instead of blocking."""
+        pb, self._long_queues[width] = self._form_pop(
+            width, self._long_queues[width], now, width,
+            self.long_rows[width], self.long_segments[width])
+        return pb
+
+    def _form_pop(self, key, queue: List[_Request], now: float, width: int,
+                  rows: int, segments: int):
+        """Shared pop core: form, account, return (batch, leftovers)."""
         pb, leftover = form_packed_batch(
-            self._pack_queue, now, self.pack_width, self.pack_rows,
-            self.pack_segments, self.engine.tokenizer.pad_id,
-            self.max_wait_ms / 1e3)
-        self._pack_queue = leftover
+            queue, now, width, rows, segments,
+            self.engine.tokenizer.pad_id, self.max_wait_ms / 1e3)
         self._pending -= len(pb.requests)
         self._pending_tokens -= pb.tokens
+        self._queue_tokens[key] -= pb.tokens
         self.metrics.queue_depth.set(self._pending)
         self.metrics.queue_tokens.set(self._pending_tokens)
-        return pb
+        return pb, leftover
 
     def _pop(self, bucket: int, n: int) -> List[_Request]:
         q = self._queues[bucket]
@@ -579,7 +720,7 @@ class DynamicBatcher:
         """Seconds until the earliest timeout/deadline, or None to sleep."""
         now = time.monotonic()
         ticks = []
-        for q in list(self._queues.values()) + [self._pack_queue]:
+        for q in self._all_queues():
             for r in q:
                 ticks.append(r.submitted + self.max_wait_ms / 1e3)
                 if r.deadline is not None:
@@ -624,12 +765,17 @@ class DynamicBatcher:
                 "max_queue": self.max_queue}
 
     def warmup(self) -> None:
-        """Pre-trace every shape live traffic can reach: the single fixed
-        packed shape on the packed path, one batch per bucket on the padded
-        path — after this, steady-state serving never compiles."""
+        """Pre-trace every shape live traffic can reach: the fixed packed
+        shape plus one fixed ``(w, long_rows[w], "packed")`` shape per
+        chunked-prefill width on the packed path, one batch per bucket on
+        the padded path — after this, steady-state serving never
+        compiles."""
         if self.packed:
             self.engine.warmup_packed(self.pack_width, self.pack_rows,
                                       self.pack_segments)
+            for w in self.long_widths:
+                self.engine.warmup_packed(w, self.long_rows[w],
+                                          self.long_segments[w])
         else:
             self.engine.warmup(self.buckets, self.max_batch_size)
 
@@ -710,7 +856,7 @@ class DynamicBatcher:
             now = tr.now()
             oldest = max(t0 - r.submitted for r, _ in live)
             tr.record("queue_wait", now - oldest, now,
-                      bucket=self.pack_width, rows=len(live), packed=True,
+                      bucket=pb.width, rows=len(live), packed=True,
                       request_ids=exemplar_ids([r for r, _ in live]))
             for r, (row, slot) in live:
                 record_hop(tr, r.rid, "pack", row=row, slot=slot)

@@ -162,10 +162,13 @@ def build_sp_trainer(args: Args, mesh=None):
 
     if resolve_length_mode(args) != "full":
         raise ValueError(
-            "--length_mode bucket/pack is not supported on the sequence-"
-            "parallel path: the ring slices one fixed global sequence "
-            "across devices, and the packed block-diagonal bias cannot "
-            "ride the ring — use the dp/zero strategies")
+            "--length_mode bucket/pack is not wired into the sequence-"
+            "parallel TRAINER yet: the ring/step layer itself speaks the "
+            "packed channel layout as of PR 12 (per-hop shard-local masks, "
+            "cross-shard [CLS] gather — parity in tests/test_longcontext."
+            "py), but this entrypoint's loader/fuse wiring still assumes "
+            "one full-width shape per step — use the dp/zero strategies "
+            "for length-aware training")
     if mesh is None:
         init_runtime(args)
         shape = args.mesh_shape or {"data": 1, SEQ: len(jax.devices())}

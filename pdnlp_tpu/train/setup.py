@@ -75,28 +75,62 @@ def build_length_train_loader(args, train, col, train_enc, *, batch_size,
     - ``pack``: the split is packed once into multi-example rows
       (``data.packing``); epochs shuffle packed rows through the ordinary
       shard sampler — one static shape, ~1/segments-per-row the steps.
+      When ``--length_buckets`` names SEVERAL kernel-tiling widths
+      (multiples of 128) whose largest covers the encode width, packing
+      goes multi-width (``MultiWidthPackedDataset``): each example packs
+      at its smallest covering width, per-width segment caps
+      (``data.packing.segment_cap``), and the length-grouped sampler
+      batches width-homogeneous packed rows — the long-document layout
+      the segment-native flash kernel serves at 512-2048.
+
+    Both bucket and pack validate the bucket widths against the model's
+    position-table size at setup (``validate_length_buckets``) — an
+    out-of-table width would silently gather garbage embeddings (JAX
+    clamps the gather), so it is a loud setup error instead.
 
     Eval loaders stay unpacked/full-width in every mode: eval semantics
     (and the dev-accuracy definition) never change with the training
     layout.
     """
-    from pdnlp_tpu.data.packing import pack_classification
+    from pdnlp_tpu.data.packing import (
+        MultiWidthPackedDataset, pack_classification,
+    )
     from pdnlp_tpu.data.sampler import (
         LengthGroupedSampler, parse_buckets, resolve_length_mode,
+        validate_length_buckets,
     )
+    from pdnlp_tpu.models import get_config
 
     mode = resolve_length_mode(args)
+    if mode in ("bucket", "pack"):
+        widths = parse_buckets(args.length_buckets, args.max_seq_len)
+        validate_length_buckets(
+            widths, max_position=get_config(args.model).max_position,
+            model=args.model, mode=mode, max_seq_len=args.max_seq_len)
     if mode == "bucket":
         sampler = LengthGroupedSampler(
             train_enc.lengths(), batch_size=batch_size,
-            buckets=parse_buckets(args.length_buckets, args.max_seq_len),
+            buckets=widths,
             num_shards=num_shards, shard_id=shard_id, shuffle=True,
             seed=args.seed)
         return DataLoader(train, col, batch_size, sampler=sampler,
                           prefetch=args.prefetch, encoded=train_enc)
     if mode == "pack":
-        packed = pack_classification(
-            train_enc, max_segments=getattr(args, "pack_max_segments", 16))
+        cap = getattr(args, "pack_max_segments", 16)
+        # multi-width needs >1 kernel-tiling width AND coverage of the
+        # encode width; otherwise the legacy single-width pack (one
+        # static shape at max_seq_len, resident-pipeline-eligible) stands
+        tiling = tuple(w for w in widths if w >= 128 and w % 128 == 0)
+        if len(tiling) > 1 and tiling[-1] >= args.max_seq_len:
+            packed = MultiWidthPackedDataset(train_enc, tiling,
+                                             max_segments=cap)
+            sampler = LengthGroupedSampler(
+                packed.row_width_table(), batch_size=batch_size,
+                buckets=tiling, num_shards=num_shards, shard_id=shard_id,
+                shuffle=True, seed=args.seed)
+            return DataLoader(train, col, batch_size, sampler=sampler,
+                              prefetch=args.prefetch, encoded=packed)
+        packed = pack_classification(train_enc, max_segments=cap)
         return DataLoader(
             train, col, batch_size,
             sampler=DistributedShardSampler(len(packed), num_shards,

@@ -180,7 +180,20 @@ class Args:
                                                   # the last bucket
     pack_max_segments: int = 16                   # examples per packed row
                                                   # cap (static shape of the
-                                                  # per-segment channels)
+                                                  # per-segment channels) at
+                                                  # the 128-token base width;
+                                                  # wider rows scale linearly
+                                                  # (data.packing.segment_cap)
+    serve_long_widths: str = ""                   # chunked-prefill widths for
+                                                  # the online batcher, e.g.
+                                                  # "512,1024": requests over
+                                                  # the pack width ride
+                                                  # long-width packed flushes
+                                                  # interleaved behind short
+                                                  # traffic (serve/batcher.py;
+                                                  # "" = long requests
+                                                  # truncate at the largest
+                                                  # bucket, the legacy path)
     prefetch: int = 2                             # loader collation lookahead
     pipeline: str = "auto"                        # input pipeline (data/
                                                   # pipeline.py): auto|

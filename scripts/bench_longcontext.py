@@ -77,12 +77,39 @@ def run(name, seq, batch, attn, remat=True, extra=None):
     return r
 
 
-def _dump(res):
+def _dump(res, path=PATH):
     """Atomic artifact write: an interrupt mid-dump must not eat the
     previously measured (minutes-of-chip-time) rows."""
-    tmp = PATH + ".tmp"
+    tmp = path + ".tmp"
     json.dump(res, open(tmp, "w"), indent=2)
-    os.replace(tmp, PATH)
+    os.replace(tmp, path)
+
+
+def merge_rows(new_rows, path=PATH, device=None):
+    """Merge freshly measured rows into ``results/longcontext.json``
+    WITHOUT clobbering history: an existing row without an ``"error"``
+    key is never overwritten (the committed v5e rows are minutes of chip
+    time; a CPU smoke re-run must not eat them) — only error rows and
+    new names take the incoming value.  ``meta.device`` is only stamped
+    when absent, for the same reason.  Returns the merged dict (also
+    written to ``path``) and the list of row names actually merged —
+    ``bench.py --longcontext`` funnels its smoke rows through here, and
+    the non-clobber property is pinned by ``tests/test_longcontext.py``.
+    """
+    res = json.load(open(path)) if os.path.exists(path) else {}
+    res.setdefault("meta", {})
+    res.setdefault("rows", {})
+    merged = []
+    for name, row in new_rows.items():
+        old = res["rows"].get(name)
+        if old is not None and "error" not in old:
+            continue  # history wins
+        res["rows"][name] = row
+        merged.append(name)
+    if device and "device" not in res["meta"]:
+        res["meta"]["device"] = device
+    _dump(res, path)
+    return res, merged
 
 
 def main():
@@ -125,7 +152,13 @@ def main():
             continue
         if name in res["rows"] and "error" not in res["rows"][name]:
             continue
-        res["rows"][name] = run(name, *spec)
+        row = run(name, *spec)
+        if "error" in row:
+            # one retry: first-touch chip init / compile-cache races are
+            # the observed transient class; a second error is real
+            print(f"{name}: retrying once after error", file=sys.stderr)
+            row = run(name, *spec)
+        res["rows"][name] = row
         _dump(res)
 
     # the sequence-parallel path at 1024: the sp entrypoint itself (ring

@@ -207,6 +207,16 @@ def main(argv=None) -> None:
     args = parse_cli(argv, base=Args())
     buckets = (tuple(int(b) for b in buckets_s.split(",")) if buckets_s
                else DEFAULT_BUCKETS)
+    # chunked prefill (--serve_long_widths "512,1024"): single-replica
+    # frontend only — the router's queues stay short-width; a long request
+    # hitting a router deployment truncates at the largest bucket as before
+    long_widths = tuple(int(w) for w in
+                        str(args.serve_long_widths or "").split(",")
+                        if str(w).strip())
+    if long_widths and replicas > 1:
+        sys.exit("serve_tpu: --serve_long_widths is the single-replica "
+                 "DynamicBatcher path (chunked prefill); drop it or run "
+                 "--replicas 1")
 
     from pdnlp_tpu.data.corpus import id2label
 
@@ -331,6 +341,7 @@ def main(argv=None) -> None:
             max_wait_ms=max_wait, max_queue=max_queue,
             default_deadline_ms=deadline, serve_pack=serve_pack,
             pack_max_segments=getattr(args, "pack_max_segments", 16),
+            long_widths=long_widths,
         ).start()
         # warmup over the batcher's OWN resolved shapes: one definition of
         # "usable" buckets AND of the pack mode (batcher.resolve_serve_pack

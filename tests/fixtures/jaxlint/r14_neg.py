@@ -1,0 +1,33 @@
+"""R14 negatives: no hot-path quadratic bias."""
+import jax
+import jax.numpy as jnp
+
+from pdnlp_tpu.data.packing import segment_bias
+
+
+def build_dataset(texts):
+    # not a hot-path scope: offline data prep may materialize freely
+    return segment_bias(texts)
+
+
+def make_train_step():
+    def train_step(state, batch):
+        # routed, not materialized: the IDs ride through
+        return state, batch["segment_ids"]
+
+    return jax.jit(train_step)
+
+
+def build_eval_step(q_seg, k_seg):
+    def eval_step(params, batch):
+        # DIFFERENT bases: the ring's per-hop local block, not the
+        # global self-outer-product
+        same = q_seg[:, :, None] == k_seg[:, None, :]
+        # short literal width: not the >=512 blowup class
+        small = jnp.zeros((4, 1, 128, 128))
+        # width via variables: not statically known, stays quiet
+        s = batch["input_ids"].shape[1]
+        dyn = jnp.zeros((4, 1, s, s))
+        return same, small, dyn
+
+    return eval_step

@@ -290,6 +290,38 @@ def test_r13_hint_names_the_choke_point():
     assert "_actuate" in f.hint and "pdnlp_tpu.obs.decision" in f.hint
 
 
+def test_r14_quadratic_bias_positive():
+    # segment_bias call / ID outer-product / literal [.., 512, 512]
+    # buffer, each in a hot-path builder scope
+    assert all_hits("r14_pos.py") == [("R14", 10), ("R14", 18),
+                                      ("R14", 25)]
+
+
+def test_r14_quadratic_bias_negative():
+    assert hits("r14_neg.py", "R14") == []
+
+
+def test_r14_sanctioned_site_exempt(tmp_path):
+    """ops/attention.py's XLA fallback is the ONE sanctioned
+    materialization — the rule must not flag its own escape hatch."""
+    sub = tmp_path / "pdnlp_tpu" / "ops"
+    sub.mkdir(parents=True)
+    p = sub / "attention.py"
+    p.write_text("import jax\n"
+                 "from pdnlp_tpu.data.packing import segment_bias\n\n"
+                 "def _forward(q, seg):\n"
+                 "    return segment_bias(seg)\n")
+    assert [f for f in analyze_paths([str(p)], root=str(tmp_path))
+            if f.rule_id == "R14"] == []
+
+
+def test_r14_hint_names_the_routed_alternative():
+    path = os.path.join(FIXTURES, "r14_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R14"][0]
+    assert "segment_ids" in f.hint and "ops.attention" in f.hint
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -299,9 +331,10 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    # the registry sorts by id STRING (R10..R13 between R1 and R2)
-    assert list(all_rules()) == ["R1", "R10", "R11", "R12", "R13", "R2",
-                                 "R3", "R4", "R5", "R6", "R7", "R8", "R9"]
+    # the registry sorts by id STRING (R10..R14 between R1 and R2)
+    assert list(all_rules()) == ["R1", "R10", "R11", "R12", "R13", "R14",
+                                 "R2", "R3", "R4", "R5", "R6", "R7", "R8",
+                                 "R9"]
 
 
 # -------------------------------------------------------------- suppressions

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from pdnlp_tpu.parallel import make_mesh
+from pdnlp_tpu.parallel.compat import shard_map
 from pdnlp_tpu.parallel.sp import make_sp_batch, make_sp_eval_step, make_sp_train_step
 from pdnlp_tpu.train.setup import setup_model
 from pdnlp_tpu.train.steps import make_eval_step, make_train_step
@@ -56,7 +57,7 @@ def test_ring_attention_matches_full(ndev):
 
     ref = dot_product_attention(q, k, v, mask_bias(mask), impl="xla")
 
-    ringed = jax.jit(jax.shard_map(
+    ringed = jax.jit(shard_map(
         lambda q, k, v, b: ring_attention(q, k, v, b, axis_name="seq"),
         mesh=mesh,
         in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq"), P(None, "seq")),
@@ -68,7 +69,7 @@ def test_ring_attention_matches_full(ndev):
     # gradients through the ring (ppermute backward) match too
     g_ref = jax.grad(lambda q: (dot_product_attention(
         q, k, v, mask_bias(mask), impl="xla") ** 2).sum())(q)
-    g_ring = jax.grad(lambda q: (jax.shard_map(
+    g_ring = jax.grad(lambda q: (shard_map(
         lambda q, k, v, b: ring_attention(q, k, v, b, axis_name="seq"),
         mesh=mesh,
         in_specs=(P(None, "seq"),) * 4,
@@ -99,7 +100,7 @@ def test_ring_attention_dropout(ndev):
             return ring_attention(q, k, v, b, axis_name="seq",
                                   dropout_rate=rate, dropout_rng=key)
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             inner, mesh=mesh,
             in_specs=(P(None, "seq"),) * 4 + (P(),),
             out_specs=P(None, "seq"),
