@@ -1307,6 +1307,528 @@ def replay_smoke(argv) -> None:
                  + f"\n  see {out_path}")
 
 
+def fleet_smoke(argv) -> None:
+    """``--fleet``: the multi-model fleet gate (ROADMAP item 4) — three
+    proofs over one reused engine set (2x primary bf16, 1x candidate
+    loading a deliberately-PERTURBED checkpoint, 1x cheap int8 of the
+    same weights):
+
+    **(a) shadow impact** — the same seeded storm runs through
+    control (no shadow) and treatment (``--fleet_shadow``, default 20%
+    shadow onto the bad candidate) fleets, INTERLEAVED twice per arm
+    (loaded-CI discipline, same as ``--telemetry``), at a rate
+    auto-calibrated to the host's measured forward capacity (explicit
+    ``--fleet_qps`` pins it).  Gates: per-request argmax outcomes are
+    IDENTICAL across every pass (the candidate's answers measurably
+    differ — parity mismatches prove the comparison is real — yet no
+    caller ever sees one), best-arm p99 within the latency margin,
+    every chain (incl. every shadow duplicate's, terminating shadow-side)
+    complete through the file round trip, zero post-warmup retraces.
+
+    **(b) canary rollout** — two storms under a
+    :class:`~pdnlp_tpu.serve.controller.ServeController` rollout law:
+    a GOOD candidate (same checkpoint) advances the canary fraction up
+    the :class:`RolloutPlan` steps on live shadow-parity evidence; then
+    the BAD candidate is pushed to 25% via the controller's own
+    ``inject`` choke point mid-storm and the law AUTO-ROLLS-BACK to 0
+    (parity regression), draining the candidate's queue to the primary.
+    Gates: good rollout reaches >= the second step with zero rollbacks;
+    bad rollout ends at fraction 0 with >= 1 recorded rollback, zero
+    lost requests, and complete decision chains both ways.
+
+    **(c) degrade tier** — a back-to-back overload burst against a
+    tight primary ladder, control (no cheap model: the pre-fleet ladder
+    sheds it) vs treatment (degrade band re-routes to the int8 cheap
+    pool).  Gates: control sheds >= 1; treatment sheds/rejects 0 with
+    >= 1 degraded request, every degraded chain carrying its ``degrade``
+    hop before dispatch, and the cheap model's per-model metrics showing
+    exactly the shifted traffic.
+
+    Snapshot: ``results/fleet_smoke.json`` (non-zero exit on any gate).
+    """
+    import dataclasses
+    import tempfile
+    import time
+
+    import jax
+    import numpy as np
+
+    from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
+    from pdnlp_tpu.obs.decision import validate_decisions
+    from pdnlp_tpu.obs.export import load_records
+    from pdnlp_tpu.obs.request import validate_chains
+    from pdnlp_tpu.serve import (
+        FleetRouter, InferenceEngine, LoadShedError, QueueFullError,
+        ReplicaRouter, RolloutPlan, ServeController,
+    )
+    from pdnlp_tpu.serve.controller import KnobSpec, default_specs
+    from pdnlp_tpu.serve.replay import ids_for, replay, synth_arrivals
+    from pdnlp_tpu.train import checkpoint as ckpt_mod
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, n_requests = pop_cli_flag(argv, "--fleet_requests", 600, int)
+    argv, base_qps = pop_cli_flag(argv, "--fleet_qps", None, float)
+    argv, shadow_fraction = pop_cli_flag(argv, "--fleet_shadow", 0.2,
+                                         float)
+    argv, deadline_ms = pop_cli_flag(argv, "--fleet_deadline_ms",
+                                     30_000.0, float)
+    argv, p99_factor = pop_cli_flag(argv, "--fleet_p99_factor", 1.5,
+                                    float)
+    argv, p99_margin_ms = pop_cli_flag(argv, "--fleet_p99_margin_ms",
+                                       25.0, float)
+    argv, out_path = pop_cli_flag(
+        argv, "--fleet_out", os.path.join("results", "fleet_smoke.json"))
+
+    trace_dir = tempfile.mkdtemp(prefix="pdnlp-fleet-trace-")
+    ckpt_dir = tempfile.mkdtemp(prefix="pdnlp-fleet-ckpt-")
+    args = parse_cli(argv, base=Args(model="bert-tiny", trace=True,
+                                     trace_dir=trace_dir))
+
+    import random as _random
+
+    chars = "天地人你我他好坏大小上下来去爱恨喜怒哀乐高兴悲伤讨厌愤怒"
+    vocab_texts = ["".join(_random.Random(args.seed).choice(chars)
+                           for _ in range(24)) for _ in range(64)]
+    tok = WordPieceTokenizer(build_vocab(vocab_texts, size=256))
+    buckets = (32,)
+    batch_size = 8
+
+    # ONE engine set reused across every phase (compile once): the
+    # per-group checkpoint_path makes each router's warmup load the right
+    # artifact onto its engines
+    eng_prim = [InferenceEngine(args, tokenizer=tok, mesh=None)
+                for _ in range(2)]
+    eng_cand = [InferenceEngine(args, tokenizer=tok, mesh=None)]
+    eng_cheap = [InferenceEngine(
+        dataclasses.replace(args, serve_dtype="int8"),
+        tokenizer=tok, mesh=None)]
+    tracer = eng_prim[0].tracer
+
+    # the good checkpoint = the shared init weights; the BAD candidate
+    # checkpoint is the same tree with the classifier head's class axis
+    # ROLLED by one (every leaf whose last dim is num_labels) —
+    # shape-valid, loads cleanly, and every answer is deterministically
+    # the wrong class (logits permuted), which is exactly the regression
+    # shadow parity exists to catch
+    host = jax.device_get(eng_prim[0].params)
+    good_ckpt = os.path.join(ckpt_dir, "good-cls.msgpack")
+    ckpt_mod.save(good_ckpt, host)
+    bad_ckpt = os.path.join(ckpt_dir, "bad-cls.msgpack")
+    n_labels = args.num_labels
+    ckpt_mod.save(bad_ckpt, jax.tree_util.tree_map(
+        lambda a: (np.roll(np.asarray(a), 1, axis=-1)
+                   if np.asarray(a).ndim >= 1
+                   and np.asarray(a).shape[-1] == n_labels
+                   else np.asarray(a)), host))
+
+    def make_group(mid, engines, ckpt_path, **kw):
+        kw.setdefault("max_queue", 512)
+        return ReplicaRouter(
+            engines, buckets=buckets, max_batch_size=batch_size,
+            max_wait_ms=5.0, stall_timeout=10.0, poll_interval=0.02,
+            serve_pack="off", checkpoint_path=ckpt_path, model_id=mid,
+            tracer=tracer, **kw)
+
+    def start_fleet(fleet):
+        fleet.start()
+        if not fleet.wait_ready(600):
+            sys.exit("fleet smoke FAILED: a pool never finished warmup")
+        return fleet
+
+    failures: list = []
+
+    # ---- calibration (deflake): the storm rate rides the HOST's measured
+    # forward capacity, so the shadow-impact comparison sits in the same
+    # sub-saturation regime on fast and slow CI hosts alike
+    warm = make_group("prod", eng_prim, good_ckpt)
+    start_fleet(FleetRouter({"prod": warm}, primary="prod",
+                            tracer=tracer)).stop(drain=False)
+    probe_ids = [[tok.cls_id, 7, 9, tok.sep_id]] * batch_size
+    forward_ts = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        # infer_ids returns HOST numpy — real wall time, not an enqueue
+        eng_prim[0].infer_ids(probe_ids, buckets[0], rows=batch_size)
+        forward_ts.append(time.perf_counter() - t0)  # jaxlint: disable=R4 — infer_ids blocked on host results above
+    forward_ms = sorted(forward_ts)[len(forward_ts) // 2] * 1e3
+    capacity_rps = len(eng_prim) * batch_size / (forward_ms / 1e3)
+    if base_qps is None:
+        base_qps = round(min(800.0, max(100.0, 0.25 * capacity_rps)), 1)
+    schedule = synth_arrivals(n_requests, base_qps,
+                              lengths=(6, 9, 12, 16, 20, 26),
+                              deadline_ms=deadline_ms, seed=args.seed)
+
+    # ---------------------------------------------- (a) shadow impact
+    def run_storm(shadow_frac: float, label: str) -> dict:
+        tracer.clear()
+        prim = make_group("prod", eng_prim, good_ckpt)
+        cand = make_group("cand", eng_cand, bad_ckpt)
+        fleet = start_fleet(FleetRouter(
+            {"prod": prim, "cand": cand}, primary="prod",
+            candidate="cand", shadow_fraction=shadow_frac, tracer=tracer))
+        futs: list = []
+
+        def submit(ids, deadline_ms=None):
+            f = fleet.submit_ids(ids, deadline_ms=deadline_ms)
+            futs.append(f)
+            return f
+
+        rep = replay(submit, schedule)
+        fleet.stop(drain=True)
+        chains_rep = validate_chains(load_records(tracer.flush()))
+        chains_rep["incomplete"] = dict(
+            list(chains_rep["incomplete"].items())[:5])
+        out = {
+            "label": label, "shadow_fraction": shadow_frac,
+            **rep.as_dict(),
+            "p99_ms": round(prim.metrics.request_latency_ms
+                            .percentile(99) or 0.0, 2),
+            "argmaxes": [int(np.argmax(f._logits))
+                         if f._error is None and f._logits is not None
+                         else None for f in futs],
+            "retraces_post_warmup": fleet.retraces_post_warmup,
+            "chains": {k: v for k, v in chains_rep.items()
+                       if k != "incomplete"},
+            "chains_incomplete": chains_rep["incomplete"],
+            "fleet": fleet.metrics.snapshot(),
+            "shadow": fleet.shadow_report.snapshot(),
+        }
+        print(f"[fleet] {label}: p99 {out['p99_ms']}ms  ok {rep.ok}"
+              f"/{rep.submitted}  shadows {out['fleet']['shadows_total']}"
+              f"  parity {out['shadow']['checked']} checked "
+              f"{out['shadow']['mismatches']} mismatched",
+              file=sys.stderr)
+        return out
+
+    arms: dict = {"control": [], "shadow": []}
+    for i in range(2):  # interleaved passes (loaded-CI discipline)
+        arms["control"].append(run_storm(0.0, f"control/pass{i}"))
+        arms["shadow"].append(run_storm(shadow_fraction,
+                                        f"shadow/pass{i}"))
+
+    baseline_argmax = arms["control"][0]["argmaxes"]
+    for arm in ("control", "shadow"):
+        for run in arms[arm]:
+            if run["argmaxes"] != baseline_argmax:
+                diff = sum(1 for a, b in zip(run["argmaxes"],
+                                             baseline_argmax) if a != b)
+                failures.append(
+                    f"(a) {run['label']}: caller-visible outcomes differ "
+                    f"from the no-shadow control ({diff} of "
+                    f"{len(baseline_argmax)} argmaxes)")
+            if run["lost"] or run["deadline"] or run["shed"] \
+                    or run["rejected"]:
+                failures.append(f"(a) {run['label']}: outcome split not "
+                                "clean under the calibrated storm "
+                                f"({run['lost']} lost, {run['deadline']} "
+                                f"deadline, {run['shed']} shed, "
+                                f"{run['rejected']} rejected)")
+            if run["retraces_post_warmup"]:
+                failures.append(f"(a) {run['label']}: "
+                                f"{run['retraces_post_warmup']} "
+                                "post-warmup retraces")
+            if run["chains_incomplete"]:
+                failures.append(f"(a) {run['label']}: incomplete chains "
+                                f"{run['chains_incomplete']}")
+    control_p99 = min(r["p99_ms"] for r in arms["control"])
+    shadow_p99 = min(r["p99_ms"] for r in arms["shadow"])
+    if shadow_p99 > control_p99 * p99_factor + p99_margin_ms:
+        failures.append(
+            f"(a) shadow p99 {shadow_p99}ms exceeds the no-shadow "
+            f"control's {control_p99}ms beyond the margin "
+            f"(x{p99_factor} + {p99_margin_ms}ms)")
+    expect_shadows = int(shadow_fraction * n_requests)
+    for run in arms["shadow"]:
+        got = run["fleet"]["shadows_total"]
+        if abs(got - expect_shadows) > 1:
+            failures.append(f"(a) {run['label']}: {got} shadows vs the "
+                            f"{expect_shadows} the fraction promises")
+        if run["shadow"]["mismatches"] < 1:
+            failures.append(f"(a) {run['label']}: the perturbed candidate "
+                            "produced ZERO argmax mismatches — the parity "
+                            "comparison cannot be real")
+        if run["chains"]["shadowed"] < got:
+            failures.append(f"(a) {run['label']}: only "
+                            f"{run['chains']['shadowed']} shadow chains "
+                            f"for {got} shadow submissions")
+
+    # ------------------------------------- (b) canary rollout + rollback
+    def rollout_controller(fleet, plan):
+        specs = default_specs()
+        specs["canary_fraction"] = KnobSpec(
+            "canary_fraction", 0.0, 1.0, cooldown_s=0.25, hysteresis=0.0,
+            signal="p99_ms", noise_floor=50.0)
+        return ServeController(
+            fleet, interval_s=0.05, specs=specs, rollout=plan,
+            eval_window_s=0.4, revert_margin=1.0,
+            manage_flush=False, manage_admission=False,
+            manage_hedge=False, scale_patience=10 ** 6, tracer=tracer)
+
+    def run_rollout(cand_ckpt: str, label: str, inject_frac, plan
+                    ) -> dict:
+        tracer.clear()
+        prim = make_group("prod", eng_prim, good_ckpt)
+        cand = make_group("cand", eng_cand, cand_ckpt)
+        fleet = start_fleet(FleetRouter(
+            {"prod": prim, "cand": cand}, primary="prod",
+            candidate="cand",
+            shadow_fraction=max(shadow_fraction, 0.25), tracer=tracer))
+        ctl = rollout_controller(fleet, plan).start()
+        futs: list = []
+        inject_at = len(schedule) // 3
+        injected = {"done": False}
+
+        def on_tick(i: int) -> None:
+            if inject_frac is not None and i == inject_at \
+                    and not injected["done"]:
+                # the optimistic-operator push, through the controller's
+                # own choke point: clamped, decision-recorded — and WRONG
+                injected["done"] = ctl.inject("canary_fraction",
+                                              inject_frac)
+
+        def submit(ids, deadline_ms=None):
+            f = fleet.submit_ids(ids, deadline_ms=deadline_ms)
+            futs.append(f)
+            return f
+
+        rep = replay(submit, schedule, on_tick=on_tick)
+        # the law needs a few quiet ticks to finish judging (and the
+        # rollback drain to land) after the storm's tail
+        deadline_t = time.monotonic() + 5.0
+        want_zero = inject_frac is not None
+        while time.monotonic() < deadline_t:
+            frac = fleet.canary_fraction
+            if (want_zero and frac == 0.0) or \
+                    (not want_zero and frac >= plan.steps[1]):
+                break
+            time.sleep(0.05)
+        ctl.stop()
+        fleet.stop(drain=True)
+        lost = sum(1 for f in futs
+                   if f._error is not None
+                   and not isinstance(f._error, (LoadShedError,)))
+        trace_path = tracer.flush()
+        records = load_records(trace_path)
+        chains_rep = validate_chains(records)
+        chains_rep["incomplete"] = dict(
+            list(chains_rep["incomplete"].items())[:5])
+        decisions = validate_decisions(records)
+        decisions["incomplete"] = dict(
+            list(decisions["incomplete"].items())[:5])
+        out = {
+            "label": label, **rep.as_dict(), "lost_futures": lost,
+            "injected": injected["done"],
+            "final_fraction": fleet.canary_fraction,
+            "canary_routed": fleet.metrics.canary_routed_total.value,
+            "rollbacks": fleet.metrics.rollbacks_total.value,
+            "rolled_back_requests":
+                fleet.metrics.rolled_back_requests_total.value,
+            "controller": {"actuations": ctl.actuations_total,
+                           "rollbacks": ctl.rollbacks_total,
+                           "reverts": ctl.reverts_total,
+                           "errors": ctl.errors_total},
+            "decisions": decisions,
+            "chains": {k: v for k, v in chains_rep.items()
+                       if k != "incomplete"},
+            "chains_incomplete": chains_rep["incomplete"],
+            "shadow": fleet.shadow_report.snapshot(),
+            "retraces_post_warmup": fleet.retraces_post_warmup,
+        }
+        print(f"[fleet] {label}: fraction {out['final_fraction']}  "
+              f"canary_routed {out['canary_routed']}  rollbacks "
+              f"{out['rollbacks']}  actuations "
+              f"{out['controller']['actuations']}", file=sys.stderr)
+        return out
+
+    good_plan = RolloutPlan(steps=(0.1, 0.25, 0.5), min_shadow_checked=10,
+                            parity_tolerance=0.02, p99_factor=50.0,
+                            patience=1)
+    good_run = run_rollout(good_ckpt, "rollout/good", None, good_plan)
+    bad_plan = RolloutPlan(steps=(0.25, 0.5, 1.0), min_shadow_checked=10,
+                          parity_tolerance=0.02, p99_factor=50.0,
+                          patience=2)
+    bad_run = run_rollout(bad_ckpt, "rollout/bad", 0.25, bad_plan)
+
+    if good_run["final_fraction"] < good_plan.steps[1]:
+        failures.append(
+            f"(b) good rollout stalled at fraction "
+            f"{good_run['final_fraction']} (< step {good_plan.steps[1]}) "
+            "— the law never advanced on clean parity evidence")
+    if good_run["rollbacks"]:
+        failures.append(f"(b) good rollout was rolled back "
+                        f"{good_run['rollbacks']}x on clean evidence")
+    if not bad_run["injected"]:
+        failures.append("(b) the bad-canary fraction was never injected")
+    if bad_run["final_fraction"] != 0.0 or bad_run["rollbacks"] < 1:
+        failures.append(
+            f"(b) the bad canary was NOT auto-rolled-back (final "
+            f"fraction {bad_run['final_fraction']}, "
+            f"{bad_run['rollbacks']} rollbacks)")
+    if bad_run["canary_routed"] < 1:
+        failures.append("(b) the injected fraction routed no caller "
+                        "traffic — the rollback undid nothing real")
+    for run in (good_run, bad_run):
+        if run["lost"] or run["lost_futures"]:
+            failures.append(f"(b) {run['label']}: {run['lost']} lost in "
+                            f"replay, {run['lost_futures']} failed "
+                            "futures — a rollout must never lose "
+                            "accepted work")
+        if run["decisions"]["incomplete"]:
+            failures.append(f"(b) {run['label']}: incomplete decision "
+                            f"chains {run['decisions']['incomplete']}")
+        if run["chains_incomplete"]:
+            failures.append(f"(b) {run['label']}: incomplete request "
+                            f"chains {run['chains_incomplete']}")
+        if run["retraces_post_warmup"]:
+            failures.append(f"(b) {run['label']}: "
+                            f"{run['retraces_post_warmup']} post-warmup "
+                            "retraces")
+
+    # --------------------------------------------------- (c) degrade tier
+    def degrade_burst(with_cheap: bool, label: str) -> dict:
+        tracer.clear()
+        prim = make_group("prod", eng_prim, good_ckpt, max_queue=16,
+                          backpressure_at=8,
+                          degrade_at=10 if with_cheap else None,
+                          shed_at=12, backpressure_wait_ms=1.0,
+                          shed_slack_ms=2 * deadline_ms)
+        groups = {"prod": prim}
+        if with_cheap:
+            groups["tiny"] = make_group("tiny", eng_cheap, good_ckpt)
+        fleet = start_fleet(FleetRouter(
+            groups, primary="prod",
+            cheap="tiny" if with_cheap else None, tracer=tracer))
+        futs: list = []
+        shed = rejected = 0
+        n_burst = 120
+        for i in range(n_burst):  # back-to-back: the overload burst
+            try:
+                futs.append(fleet.submit_ids(
+                    ids_for(schedule[i % len(schedule)], i),
+                    deadline_ms=deadline_ms))
+            except LoadShedError:
+                shed += 1
+            except QueueFullError:
+                rejected += 1
+        ok = lost = queued_shed = expired = 0
+        for f in futs:
+            try:
+                f.result(timeout=deadline_ms / 1e3 + 10)
+                ok += 1
+            except LoadShedError:
+                queued_shed += 1
+            except Exception as e:  # noqa: BLE001
+                if "Deadline" in type(e).__name__:
+                    expired += 1
+                else:
+                    lost += 1
+        fleet.stop(drain=True)
+        chains_rep = validate_chains(load_records(tracer.flush()))
+        chains_rep["incomplete"] = dict(
+            list(chains_rep["incomplete"].items())[:5])
+        snap = fleet.snapshot()
+        out = {
+            "label": label, "burst": n_burst, "ok": ok,
+            "shed_on_arrival": shed, "shed_queued": queued_shed,
+            "rejected": rejected, "deadline": expired, "lost": lost,
+            "degraded": fleet.metrics.degraded_total.value,
+            "degrade_fallthrough":
+                fleet.metrics.degrade_fallthrough_total.value,
+            "per_model_requests": {
+                mid: snap["models"][mid]["router"]["requests_total"]
+                for mid in snap["models"]},
+            "chains": {k: v for k, v in chains_rep.items()
+                       if k != "incomplete"},
+            "chains_incomplete": chains_rep["incomplete"],
+            "retraces_post_warmup": fleet.retraces_post_warmup,
+        }
+        print(f"[fleet] {label}: ok {ok}/{n_burst}  shed "
+              f"{shed}+{queued_shed}  rejected {rejected}  degraded "
+              f"{out['degraded']}", file=sys.stderr)
+        return out
+
+    control_burst = degrade_burst(False, "degrade/control")
+    treat_burst = degrade_burst(True, "degrade/treatment")
+
+    if control_burst["shed_on_arrival"] + control_burst["shed_queued"] \
+            + control_burst["rejected"] < 1:
+        failures.append("(c) the control burst never shed/rejected — the "
+                        "overload is not an overload, nothing to absorb")
+    if treat_burst["shed_on_arrival"] or treat_burst["shed_queued"] \
+            or treat_burst["rejected"]:
+        failures.append(
+            f"(c) the degrade tier did NOT absorb the burst: "
+            f"{treat_burst['shed_on_arrival']}+"
+            f"{treat_burst['shed_queued']} shed, "
+            f"{treat_burst['rejected']} rejected with a cheap model "
+            "registered")
+    if treat_burst["degraded"] < 1:
+        failures.append("(c) no request was degraded — the band never "
+                        "engaged")
+    if treat_burst["lost"] or treat_burst["deadline"]:
+        failures.append(f"(c) treatment lost {treat_burst['lost']} / "
+                        f"expired {treat_burst['deadline']} — degraded "
+                        "work must still complete")
+    if treat_burst["chains"]["degraded"] != treat_burst["degraded"]:
+        failures.append(
+            f"(c) {treat_burst['degraded']} degrades counted but only "
+            f"{treat_burst['chains']['degraded']} chains carry the "
+            "degrade hop")
+    if treat_burst["per_model_requests"].get("tiny", 0) \
+            != treat_burst["degraded"]:
+        failures.append(
+            "(c) per-model metrics do not show the shift: cheap-model "
+            f"requests {treat_burst['per_model_requests'].get('tiny')} "
+            f"!= degraded {treat_burst['degraded']}")
+    if treat_burst["chains_incomplete"]:
+        failures.append(f"(c) incomplete chains "
+                        f"{treat_burst['chains_incomplete']}")
+
+    result = {
+        "metric": "fleet_smoke",
+        "requests": n_requests,
+        "base_qps": base_qps,
+        "calibration": {"forward_ms": round(forward_ms, 3),
+                        "capacity_rps": round(capacity_rps, 1)},
+        "deadline_ms": deadline_ms,
+        "buckets": list(buckets),
+        "batch_size": batch_size,
+        "shadow_fraction": shadow_fraction,
+        "shadow_impact": {
+            "control_p99_ms": control_p99,
+            "shadow_p99_ms": shadow_p99,
+            "p99_gate": f"<= x{p99_factor} + {p99_margin_ms}ms",
+            "outcome_parity": all(
+                r["argmaxes"] == baseline_argmax
+                for a in arms.values() for r in a),
+            "passes": [{k: v for k, v in r.items() if k != "argmaxes"}
+                       for a in arms.values() for r in a],
+        },
+        "rollout": {"good": good_run, "bad": bad_run},
+        "degrade": {"control": control_burst, "treatment": treat_burst},
+        "model": args.model,
+        "devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+    }
+
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, out_path)
+    print(json.dumps({k: v for k, v in result.items()
+                      if k not in ("shadow_impact", "rollout",
+                                   "degrade")}))
+    import shutil
+
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    if failures:
+        sys.exit("fleet smoke FAILED:\n  - " + "\n  - ".join(failures)
+                 + f"\n  see {out_path}")
+
+
 def _silent_result(fut, timeout: float = 60.0):
     """Resolve a serve future to its logits or None (probe accounting —
     the probe's burst rides normal admission, so sheds are outcomes, not
@@ -3164,6 +3686,12 @@ def main() -> None:
                 "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
         argv.remove("--longcontext")
         return longcontext_smoke(argv)
+    if "--fleet" in argv:
+        # multi-model fleet gate: shadow-impact control/treatment, canary
+        # rollout advance + bad-canary auto-rollback, degrade-tier burst
+        # (results/fleet_smoke.json) — an intercept like --replay
+        argv.remove("--fleet")
+        return fleet_smoke(argv)
     if "--replay" in argv:
         # trace-driven load replay: controller-vs-static across replayed
         # traffic shapes (results/replay_smoke.json) — an intercept like

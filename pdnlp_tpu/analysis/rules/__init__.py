@@ -18,4 +18,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r12_device_span_attr,
     r13_unrecorded_actuation,
     r14_quadratic_bias,
+    r15_unrecorded_traffic_shift,
 )

@@ -1,0 +1,106 @@
+"""R15 — fleet traffic-fraction / model-routing writes outside the
+decision-recording path.
+
+The multi-model fleet's contract (the fleet PR, extending R13's from knob
+actuations to ROLLOUT STATE) is that every traffic shift — the canary
+fraction, the shadow sampling fraction, a rollback — passes through
+:meth:`ServeController._actuate`: the choke point that clamps, cooldown-
+guards, records the decision chain (:mod:`pdnlp_tpu.obs.decision`) and
+opens the evaluation window that auto-rolls a harmful shift back.  A
+traffic-fraction write that bypasses it is an *unrecorded traffic shift*:
+caller traffic starts landing on a different model with no decision
+record, no safety clamp, and no evaluation window — the silent-rollout
+bug class, strictly worse than R13's unrecorded knob turn because the
+blast radius is answer CONTENT, not just latency.
+
+Heuristic, fleet-scope modules only (a module that imports from
+``pdnlp_tpu.serve.fleet`` — the controller's rollout law, the CLI/bench
+wiring): flag
+
+- assignments (plain or augmented) to an attribute named like a traffic
+  fraction (``fleet.canary_fraction = 0.5``,
+  ``x.shadow_fraction += 0.1``), and
+- direct calls to the fleet's raw rollback/re-home surface
+  (``._rollback_drain(...)``, ``.extract_queued(...)``, ``.adopt(...)``)
+
+anywhere outside a function named ``_actuate`` or ``_apply`` (the
+controller's applier) or ``apply_knob`` (the fleet's own setter, which
+``_apply`` calls).  :mod:`pdnlp_tpu.serve.fleet` itself owns these
+attributes (its ``__init__``/``apply_knob`` ARE the setter surface) and
+does not import itself, so it is out of scope by construction — exactly
+the R13 router/batcher precedent; test files are not on the lint surface.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pdnlp_tpu.analysis.core import Finding, ModuleInfo, Rule, register
+
+#: the traffic-split state the control plane owns once a fleet is in play
+_TRAFFIC_ATTRS = {"canary_fraction", "shadow_fraction"}
+
+#: the fleet's raw traffic-shift surface — sanctioned only beneath the
+#: decision-recording path (apply_knob is the fleet's own setter)
+_SHIFT_CALLS = {"_rollback_drain", "extract_queued", "adopt"}
+
+#: functions that ARE the decision-record path (or the fleet's setter)
+_SANCTIONED = {"_actuate", "_apply", "apply_knob"}
+
+
+@register
+class UnrecordedTrafficShift(Rule):
+    rule_id = "R15"
+    name = "unrecorded-traffic-shift"
+    hint = ("route the traffic shift through the controller's decision-"
+            "recording choke point — `self._actuate('canary_fraction', "
+            "value, cause)` (or `ServeController.inject` from test/chaos "
+            "code), which calls the fleet's `apply_knob` — so it is "
+            "clamped, recorded as a decision chain "
+            "(pdnlp_tpu.obs.decision) and auto-rolled-back if parity or "
+            "p99 regresses; raw fraction writes and rollback/adopt calls "
+            "shift caller traffic onto a different model with no record")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not self._fleet_module(mod):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr in _TRAFFIC_ATTRS \
+                            and not self._sanctioned(mod, node):
+                        yield self.finding(
+                            mod, node,
+                            f"traffic fraction '{t.attr}' written outside "
+                            "the _actuate decision-record path — an "
+                            "unrecorded, unclamped, unevaluated traffic "
+                            "shift")
+                        break
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SHIFT_CALLS \
+                    and not self._sanctioned(mod, node):
+                yield self.finding(
+                    mod, node,
+                    f"raw traffic-shift call '{node.func.attr}()' outside "
+                    "the _actuate decision-record path — caller traffic "
+                    "moves between models with no decision record and no "
+                    "evaluation window")
+
+    @staticmethod
+    def _fleet_module(mod: ModuleInfo) -> bool:
+        return any(v.startswith("pdnlp_tpu.serve.fleet")
+                   or v.endswith(".FleetRouter")
+                   for v in mod.aliases.values())
+
+    @staticmethod
+    def _sanctioned(mod: ModuleInfo, node: ast.AST) -> bool:
+        fn = mod.enclosing_function(node)
+        while fn is not None:
+            if getattr(fn, "name", None) in _SANCTIONED:
+                return True
+            fn = mod.enclosing_function(fn)
+        return False

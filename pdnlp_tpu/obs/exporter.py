@@ -41,6 +41,13 @@ from typing import Callable, Dict, List, Optional
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
+#: container keys whose CHILD KEYS become a label instead of a metric-name
+#: segment even when they are not integer-like — the fleet's per-model
+#: blocks (``models`` / ``by_model`` keyed by model id) must scrape as
+#: ``{model="primary"}`` so one dashboard query compares
+#: primary/candidate/cheap tiers instead of matching N metric names
+_LABELED_CONTAINERS = {"models": "model", "by_model": "model"}
+
 
 def _metric_name(*parts: str) -> str:
     return "_".join(_NAME_RE.sub("_", str(p)).strip("_")
@@ -80,7 +87,12 @@ def prometheus_lines(source: str, snap, prefix: str = "pdnlp"
             emit(name, labels, obj)
         elif isinstance(obj, dict):
             keys = list(obj)
-            if keys and all(re.fullmatch(r"-?\d+", str(k)) for k in keys):
+            if tail in _LABELED_CONTAINERS and keys:
+                label = _LABELED_CONTAINERS[tail]
+                for k, v in obj.items():
+                    walk(name, {**labels, label: str(k)}, v, str(k))
+            elif keys and all(re.fullmatch(r"-?\d+", str(k))
+                              for k in keys):
                 label = _label_name(tail)
                 for k, v in obj.items():
                     walk(name, {**labels, label: str(k)}, v, tail)

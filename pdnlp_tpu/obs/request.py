@@ -43,7 +43,28 @@ hop                   meaning / extra attrs
 ``requeue``           moved off an ejected replica (``from_replica``,
                       ``to_replica``, ``inflight``, ``packed`` — the
                       eject-time re-pack carries ``packed=True``)
-``complete``          logits delivered (terminal; ``replica``)
+``shadow``            fleet shadow traffic.  On the PRIMARY request's
+                      chain: a sampled duplicate was sent to the candidate
+                      model (``to_model``, ``shadow_rid``) — non-terminal,
+                      the caller still gets the primary's answer.  As the
+                      FIRST hop of a chain: this chain IS the shadow
+                      duplicate (``of`` = the primary rid, ``model``) —
+                      its terminal must carry ``shadow=True`` (it ends on
+                      the shadow side, never as a caller-visible answer)
+``degrade``           fleet overload re-route: the admission ladder's
+                      degrade band sent this arrival to the cheap model
+                      instead of shedding it (``from_model``,
+                      ``to_model``, ``tier``) — recorded BEFORE the cheap
+                      pool's ``admit``, and always before any
+                      ``dispatch``, so ``trace_tpu.py request <id>``
+                      shows who got the cheap answer and why
+``rollback``          fleet canary rollback: the request was queued on the
+                      candidate when the rollout rolled back, and was
+                      drained back to the primary (``from_model``,
+                      ``to_model``) — non-terminal; the request still gets
+                      exactly one terminal, on the primary
+``complete``          logits delivered (terminal; ``replica``; a shadow
+                      duplicate's carries ``shadow=True``)
 ``deadline``          expired before execution (terminal)
 ``shed``              dropped by the shed tier (terminal)
 ``rejected``          refused at admission (terminal — the only hop such
@@ -134,7 +155,21 @@ def chain_issues(chain: Sequence[Dict]) -> List[str]:
     A complete accepted-request chain: starts with ``admit``, contains
     exactly ONE terminal hop, and the terminal hop is last.  (A rejected
     request's whole chain is the single ``rejected`` hop — also
-    complete.)  Deliberately NO timestamp-order check here:
+    complete.)  The fleet hops extend the contract:
+
+    - a chain may open with a ``degrade`` preamble (the fleet re-routed
+      the arrival to the cheap model BEFORE that pool admitted it) — it
+      must be followed by ``admit`` (or a door refusal), and every
+      ``degrade`` must precede the first ``dispatch`` (a request cannot
+      be "degraded" after it already executed);
+    - a chain opening with ``shadow`` IS a shadow duplicate: it must
+      still terminate exactly once, and its terminal must carry
+      ``shadow=True`` — a shadow chain with a caller-visible terminal
+      means a candidate answer could have leaked to a caller;
+    - ``rollback`` is non-terminal: a rolled-back canary request still
+      gets exactly one terminal (on the primary it was drained back to).
+
+    Deliberately NO timestamp-order check here:
     :func:`hop_chain`/:func:`chains` hand over chains already sorted by
     ``t0``, so such a check could never fire — the time ordering that IS
     enforced is the merged timeline's (``trace_tpu.py merge`` sorts, the
@@ -142,11 +177,26 @@ def chain_issues(chain: Sequence[Dict]) -> List[str]:
     issues: List[str] = []
     if not chain:
         return ["empty chain"]
-    hops = [(r.get("attrs") or {}).get("hop") for r in chain]
+    attrs = [(r.get("attrs") or {}) for r in chain]
+    hops = [a.get("hop") for a in attrs]
     if len(hops) == 1 and hops[0] in ("rejected", "shed"):
         return []  # refused at the door: the one hop IS the whole life
-    if hops[0] != "admit":
+    shadow_side = hops[0] == "shadow"
+    if shadow_side:
+        if len(hops) < 2 or hops[1] not in ("admit", "rejected", "shed"):
+            issues.append("shadow duplicate not followed by 'admit' (or "
+                          "a door refusal)")
+    elif hops[0] == "degrade":
+        if len(hops) < 2 or hops[1] not in ("admit", "rejected", "shed"):
+            issues.append("degrade re-route not followed by 'admit' (or "
+                          "a door refusal)")
+    elif hops[0] != "admit":
         issues.append(f"first hop is {hops[0]!r}, not 'admit'")
+    if "dispatch" in hops:
+        first_dispatch = hops.index("dispatch")
+        if any(h == "degrade" for h in hops[first_dispatch + 1:]):
+            issues.append("'degrade' hop recorded after a dispatch — a "
+                          "degrade decision must precede execution")
     terminals = [h for h in hops if h in TERMINAL_HOPS]
     if len(terminals) == 0:
         issues.append("no terminal hop (orphaned request)")
@@ -154,14 +204,25 @@ def chain_issues(chain: Sequence[Dict]) -> List[str]:
         issues.append(f"{len(terminals)} terminal hops (duplicate "
                       f"completion): {terminals}")
     else:
+        if shadow_side:
+            term_attrs = attrs[hops.index(terminals[0])]
+            if not term_attrs.get("shadow"):
+                issues.append(
+                    f"shadow duplicate terminated with a CALLER-VISIBLE "
+                    f"{terminals[0]!r} (no shadow=True) — the candidate's "
+                    "answer may have reached a caller")
         # trailing dispatch/pack hops are BENIGN: a hedge's losing copy
         # (or a batch formed just before the monitor completed the
         # request) may record its execution marker microseconds after
         # the winner's terminal — that is truthful telemetry of a
-        # duplicate execution, not an integrity violation.  Anything
-        # ELSE after the terminal (a requeue, a second admit) is.
+        # duplicate execution, not an integrity violation.  A trailing
+        # `shadow` is the same shape: the fleet samples the duplicate
+        # right after the primary submit, and a fast engine can complete
+        # the primary in that window.  Anything ELSE after the terminal
+        # (a requeue, a rollback, a second admit) is a violation.
         tail = hops[hops.index(terminals[0]) + 1:]
-        stray = [h for h in tail if h not in ("dispatch", "pack")]
+        stray = [h for h in tail if h not in ("dispatch", "pack",
+                                              "shadow")]
         if stray:
             issues.append(f"hop(s) {stray} recorded after the terminal "
                           f"{terminals[0]!r}")
@@ -177,7 +238,8 @@ def validate_chains(records: Sequence[Dict],
     ids = list(request_ids) if request_ids is not None \
         else sorted(by_id)
     report = {"checked": len(ids), "complete": 0, "incomplete": {},
-              "requeued": 0, "repacked": 0, "hedged": 0}
+              "requeued": 0, "repacked": 0, "hedged": 0,
+              "shadowed": 0, "degraded": 0, "rolled_back": 0}
     for rid in ids:
         chain = by_id.get(rid, [])
         issues = chain_issues(chain)
@@ -193,6 +255,12 @@ def validate_chains(records: Sequence[Dict],
             report["repacked"] += 1
         if any(h.get("hop") == "hedge" for h in hops):
             report["hedged"] += 1
+        if hops and hops[0].get("hop") == "shadow":
+            report["shadowed"] += 1
+        if any(h.get("hop") == "degrade" for h in hops):
+            report["degraded"] += 1
+        if any(h.get("hop") == "rollback" for h in hops):
+            report["rolled_back"] += 1
     return report
 
 

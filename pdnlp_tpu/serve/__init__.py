@@ -39,8 +39,11 @@ from pdnlp_tpu.serve.batcher import (  # noqa: F401
 )
 from pdnlp_tpu.serve.controller import KnobSpec, ServeController  # noqa: F401
 from pdnlp_tpu.serve.engine import InferenceEngine  # noqa: F401
+from pdnlp_tpu.serve.fleet import (  # noqa: F401
+    FleetRouter, ModelSpec, RolloutPlan, ShadowReport, parse_fleet_spec,
+)
 from pdnlp_tpu.serve.metrics import (  # noqa: F401
-    ReplicaMetrics, RouterMetrics, ServeMetrics,
+    FleetMetrics, ReplicaMetrics, RouterMetrics, ServeMetrics,
 )
 from pdnlp_tpu.serve.offline import score_texts  # noqa: F401
 from pdnlp_tpu.serve.router import (  # noqa: F401
@@ -52,16 +55,22 @@ __all__ = [
     "AdmissionControl",
     "DeadlineExceeded",
     "DynamicBatcher",
+    "FleetMetrics",
+    "FleetRouter",
     "InferenceEngine",
     "KnobSpec",
     "LoadShedError",
+    "ModelSpec",
     "QueueFullError",
     "ReplicaFailedError",
     "ReplicaMetrics",
     "ReplicaRouter",
+    "RolloutPlan",
     "RouterMetrics",
     "ServeController",
     "ServeMetrics",
+    "ShadowReport",
+    "parse_fleet_spec",
     "pick_bucket",
     "resolve_serve_pack",
     "score_texts",

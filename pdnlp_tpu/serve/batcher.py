@@ -136,17 +136,28 @@ _COMPLETE_LOCK = threading.Lock()
 
 
 class _Request:
-    __slots__ = ("ids", "bucket", "submitted", "deadline", "retries",
-                 "hedged", "rid", "_event", "_logits", "_error")
+    __slots__ = ("ids", "bucket", "submitted", "born", "deadline",
+                 "retries", "hedged", "shadow_of", "rid", "_event",
+                 "_logits", "_error", "completed_at")
 
     def __init__(self, ids: List[int], bucket: int,
                  deadline: Optional[float]):
         self.ids = ids
         self.bucket = bucket
         self.submitted = time.monotonic()
+        # `submitted` may be re-stamped into a router's INJECTABLE clock
+        # domain; `born`/`completed_at` stay time.monotonic so latency
+        # deltas computed from them (the fleet's ShadowReport) are always
+        # same-domain
+        self.born = self.submitted
         self.deadline = deadline  # absolute monotonic seconds, or None
         self.retries = 0          # router: requeues after replica failure
         self.hedged = False       # router: a duplicate dispatch exists
+        # fleet: the primary request this is a SHADOW duplicate of (its
+        # rid) — a shadow's terminal hop is stamped shadow=True so the
+        # chain contract can prove no caller ever saw a candidate answer
+        self.shadow_of: Optional[str] = None
+        self.completed_at: Optional[float] = None  # fleet: parity/latency
         # the distributed-tracing identity: minted at admission, carried
         # through every hop (queue, pack, dispatch, requeue, completion)
         # so ONE id reconstructs the request's whole life — trace_tpu.py
@@ -194,6 +205,7 @@ class _Request:
                 return False
             self._logits = logits
             self._error = error
+            self.completed_at = time.monotonic()
             self._event.set()
             return True
 
@@ -281,11 +293,22 @@ class AdmissionControl:
     tier (queue depth)    policy for the arriving request
     ====================  ==================================================
     healthy               ``< backpressure_at``: accept immediately
-    backpressure          ``[backpressure_at, shed_at)``: bounded wait (at
-                          most ``backpressure_wait_ms``, never past the
+    backpressure          ``[backpressure_at, degrade_at)``: bounded wait
+                          (at most ``backpressure_wait_ms``, never past the
                           request's own deadline slack) for depth to drop,
                           then accept — converts a burst into latency
                           instead of errors
+    degrade               ``[degrade_at, shed_at)`` (only when
+                          ``degrade_at`` is set — the multi-model fleet's
+                          tier): the arrival should be RE-ROUTED to the
+                          designated cheap model instead of queued here —
+                          overload degrades answer QUALITY before it drops
+                          requests.  The re-route itself lives in the
+                          fleet front door (:class:`~pdnlp_tpu.serve.
+                          fleet.FleetRouter`); a pool walking this ladder
+                          with no cheap model behind it treats the band as
+                          an early shed tier (the pre-fleet behavior,
+                          reached ``shed_at - degrade_at`` requests sooner)
     shed                  ``[shed_at, max_queue)``: accept, but any request
                           (the arrival or a queued one — LOWEST deadline
                           slack first) whose remaining slack is under
@@ -308,6 +331,7 @@ class AdmissionControl:
     def __init__(self, max_queue: int, *,
                  backpressure_at: Optional[int] = None,
                  shed_at: Optional[int] = None,
+                 degrade_at: Optional[int] = None,
                  backpressure_wait_ms: float = 50.0,
                  shed_slack_ms: float = 0.0,
                  clock=time.monotonic):
@@ -316,21 +340,33 @@ class AdmissionControl:
                                    is not None else self.max_queue // 2)
         self.shed_at = int(shed_at if shed_at is not None
                            else (self.max_queue * 3) // 4)
+        # the degrade band is OPT-IN (None = the pre-fleet 4-tier ladder):
+        # only a fleet with a cheap model behind it should route this tier
+        self.degrade_at = None if degrade_at is None else int(degrade_at)
         if not (self.backpressure_at <= self.shed_at <= self.max_queue):
             raise ValueError(
                 f"tier thresholds must be ordered: backpressure_at "
                 f"{self.backpressure_at} <= shed_at {self.shed_at} <= "
                 f"max_queue {self.max_queue}")
+        if self.degrade_at is not None and not (
+                self.backpressure_at <= self.degrade_at <= self.shed_at):
+            raise ValueError(
+                f"degrade_at {self.degrade_at} must sit between "
+                f"backpressure_at {self.backpressure_at} and shed_at "
+                f"{self.shed_at}")
         self.backpressure_wait_ms = float(backpressure_wait_ms)
         self.shed_slack_ms = float(shed_slack_ms)
         self.clock = clock
 
     def tier(self, pending: int) -> str:
-        """``healthy`` | ``backpressure`` | ``shed`` | ``reject``."""
+        """``healthy`` | ``backpressure`` | ``degrade`` | ``shed`` |
+        ``reject`` (``degrade`` only when ``degrade_at`` is set)."""
         if pending >= self.max_queue:
             return "reject"
         if pending >= self.shed_at:
             return "shed"
+        if self.degrade_at is not None and pending >= self.degrade_at:
+            return "degrade"
         if pending >= self.backpressure_at:
             return "backpressure"
         return "healthy"

@@ -54,6 +54,9 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from pdnlp_tpu.obs.decision import mint_decision_id, record_decision
+from pdnlp_tpu.serve.fleet import RolloutPlan  # noqa: F401 — the rollout
+#   law's config type (re-exported so callers configure rollouts from the
+#   controller module they already import)
 
 
 class KnobSpec:
@@ -108,6 +111,14 @@ def default_specs() -> Dict[str, KnobSpec]:
         "replicas": KnobSpec("replicas", 1, 64, cooldown_s=15.0,
                              hysteresis=0.0, signal="p99_ms",
                              noise_floor=5.0, integer=True),
+        # the fleet's canary traffic fraction: hysteresis 0 so the small
+        # first rollout step (0.05) actuates; judged against p99 like a
+        # scale change (the rollout law's OWN parity/latency regression
+        # check is the primary rollback trigger — the eval window is the
+        # second line of defense)
+        "canary_fraction": KnobSpec("canary_fraction", 0.0, 1.0,
+                                    cooldown_s=5.0, hysteresis=0.0,
+                                    signal="p99_ms", noise_floor=5.0),
     }
 
 
@@ -201,6 +212,7 @@ class ServeController:
                  scale_patience: int = 3,
                  ewma_alpha: float = 0.4,
                  batch_rows: Optional[int] = None,
+                 rollout: Optional[RolloutPlan] = None,
                  clock: Callable[[], float] = time.monotonic,
                  tracer=None):
         self.router = router
@@ -253,6 +265,13 @@ class ServeController:
         self.tracer = tracer if tracer is not None \
             else getattr(router, "tracer", None)
 
+        #: the canary-rollout law's config (None = no rollout management;
+        #: also requires the router to BE a fleet — rollout_sense() is the
+        #: FleetRouter surface the law reads)
+        self.rollout = rollout
+        self._rollout_ticks = 0
+        self._rollout_aborted = False
+        self.rollbacks_total = 0
         knobs0 = router.knob_values()
         self._default_backpressure_at = knobs0.get("backpressure_at")
         self._default_shed_slack_ms = knobs0.get("shed_slack_ms")
@@ -262,6 +281,7 @@ class ServeController:
         self._low_ticks = 0
         self._pending: List[_PendingEval] = []
         self._last_actuated: Dict[str, float] = {}
+        self._last_did: Dict[str, str] = {}  # per-knob latest decision id
         self._hold_until: Dict[str, float] = {}
         self._strikes: Dict[str, int] = {}
         self.last_sense: Optional[_Sense] = None
@@ -390,6 +410,7 @@ class ServeController:
         self._decide_flush_age(s, cause)
         self._decide_admission(s, cause)
         self._decide_replicas(s, cause)
+        self._decide_rollout(s, cause)
 
     def _wants(self, knob: str, current, target) -> bool:
         """The decide-side hysteresis band: only a relative change beyond
@@ -493,6 +514,62 @@ class ServeController:
         else:
             self._low_ticks = 0
 
+    def _decide_rollout(self, s: _Sense, cause: Dict) -> None:
+        """The canary-rollout law: step ``canary_fraction`` up the
+        :class:`RolloutPlan` while shadow parity and candidate p99 hold;
+        ROLL BACK to 0 — through the same ``_actuate`` choke point, so
+        the undo is clamped, decision-recorded and chained to the advance
+        it reverses — the moment either regresses.  A rolled-back rollout
+        stays down: re-trying a candidate the evidence condemned needs an
+        operator (a new candidate resets the controller)."""
+        plan = self.rollout
+        sense_fn = getattr(self.router, "rollout_sense", None)
+        if plan is None or sense_fn is None:
+            return
+        rs = sense_fn()
+        frac = rs.get("canary_fraction") or 0.0
+        cause = {**cause,
+                 **{f"rollout_{k}": round(v, 6) for k, v in rs.items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)}}
+        checked = rs.get("parity_checked") or 0
+        mismatch = rs.get("mismatch_rate") or 0.0
+        p_p99 = rs.get("primary_p99_ms")
+        c_p99 = rs.get("candidate_p99_ms")
+        evidence = checked >= plan.min_shadow_checked
+        parity_bad = evidence and mismatch > plan.parity_tolerance
+        p99_bad = (p_p99 is not None and c_p99 is not None
+                   and c_p99 > plan.p99_factor * p_p99 + plan.p99_floor_ms)
+        if frac > 0 and (parity_bad or p99_bad):
+            # ROLLBACK: the fraction drops to 0 (the fleet drains the
+            # candidate's queue back to the primary), force=True so a
+            # cooldown can never delay the undo, revert_of chains it to
+            # the advance (and keeps the eval window from "reverting the
+            # rollback" — re-installing a condemned canary)
+            if self._actuate(
+                    "canary_fraction", 0.0,
+                    {**cause, "rollback": True,
+                     "parity_bad": parity_bad, "p99_bad": p99_bad},
+                    force=True,
+                    revert_of=self._last_did.get("canary_fraction",
+                                                 "rollout")):
+                self.rollbacks_total += 1
+                self._rollout_aborted = True
+                self._rollout_ticks = 0
+            return
+        if self._rollout_aborted or frac >= plan.steps[-1]:
+            return  # rolled back for good, or rollout complete
+        if not evidence or parity_bad or p99_bad:
+            self._rollout_ticks = 0
+            return
+        self._rollout_ticks += 1
+        if self._rollout_ticks < plan.patience:
+            return
+        self._rollout_ticks = 0
+        nxt = next((st for st in plan.steps if st > frac + 1e-9),
+                   plan.steps[-1])
+        self._actuate("canary_fraction", nxt, cause)
+
     # -------------------------------------------------------------- actuate
     def _actuate(self, knob: str, value, cause: Dict, *,
                  signal: Optional[str] = None, force: bool = False,
@@ -542,6 +619,7 @@ class ServeController:
                                if revert_of else {}))
         self.actuations_total += 1
         self._last_actuated[knob] = now
+        self._last_did[knob] = did
         with self._lock:
             self._pending.append(_PendingEval(
                 did, knob, old, value, signal_key, baseline,
@@ -580,6 +658,17 @@ class ServeController:
         for p in due:
             observed = s.signal(p.signal)
             spec = self.specs[p.knob]
+            # staleness: if the knob no longer holds the value this
+            # actuation set (something else — a forced rollback, a crash
+            # changing active_count — moved it since), there is nothing
+            # left to keep OR revert: "reverting" to p.old would
+            # re-install state a later decision deliberately replaced
+            # (e.g. routing caller traffic back onto a canary the
+            # rollout law just condemned)
+            current = self._knob_value(p.knob)
+            if current != p.new:
+                self._record_outcome(p, "superseded", observed)
+                continue
             # a scale-UP is never a revert candidate: the ambient signal
             # can keep worsening while the burst that triggered it is
             # still building, and "reverting" would drain capacity at
@@ -649,6 +738,11 @@ class ServeController:
             "min_replicas": self.min_replicas,
             "actuations_total": self.actuations_total,
             "reverts_total": self.reverts_total,
+            "rollbacks_total": self.rollbacks_total,
+            "rollout": ({"aborted": self._rollout_aborted,
+                         "healthy_ticks": self._rollout_ticks,
+                         "steps": list(self.rollout.steps)}
+                        if self.rollout is not None else None),
             "blocked_total": self.blocked_total,
             "errors_total": self.errors_total,
             "pending_evals": pending,

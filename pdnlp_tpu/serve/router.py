@@ -234,9 +234,11 @@ class ReplicaRouter:
         shed_at: Optional[int] = None,
         backpressure_wait_ms: float = 50.0,
         shed_slack_ms: Optional[float] = None,
+        degrade_at: Optional[int] = None,
         serve_pack: str = "auto",
         pack_max_segments: int = 16,
         max_retries: int = 1,
+        model_id: Optional[str] = None,
         hedge_ms: Optional[float] = None,
         stall_timeout: float = 10.0,
         poll_interval: float = 0.1,
@@ -275,10 +277,19 @@ class ReplicaRouter:
             backpressure_at=(backpressure_at * unit
                              if backpressure_at is not None else None),
             shed_at=shed_at * unit if shed_at is not None else None,
+            degrade_at=(degrade_at * unit
+                        if degrade_at is not None else None),
             backpressure_wait_ms=backpressure_wait_ms,
             shed_slack_ms=(2 * max_wait_ms if shed_slack_ms is None
                            else shed_slack_ms),
             clock=clock)
+        # fleet labelling: a pool serving one model of a multi-model fleet
+        # stamps that model id on every hop it records (and the fleet's
+        # snapshot keys this pool's metrics under it), so per-request
+        # chains and per-model metrics stay joinable
+        self.model_id = model_id
+        self._hop_attrs: Dict = {"model": model_id} \
+            if model_id is not None else {}
         self.max_retries = int(max_retries)
         self.hedge_ms = hedge_ms
         self.stall_timeout = float(stall_timeout)
@@ -402,6 +413,12 @@ class ReplicaRouter:
         self.stop()
 
     # ------------------------------------------------------------- metrics
+    def _hop(self, rid: str, hop: str, **attrs) -> None:
+        """One hop record with this pool's fleet labels (``model``) folded
+        in — every hop the router records comes through here so a fleet
+        pool can never emit an unlabelled hop."""
+        record_hop(self.tracer, rid, hop, **self._hop_attrs, **attrs)
+
     def _finish(self, r: _Request, logits=None, error=None,
                 latency: bool = False,
                 replica: Optional[int] = None) -> bool:
@@ -443,7 +460,12 @@ class ReplicaRouter:
                 self.metrics.failed_total.inc()
                 hop = "failed"
                 hop_attrs["error"] = type(error).__name__
-            record_hop(self.tracer, r.rid, hop, **hop_attrs)
+            if r.shadow_of is not None:
+                # the shadow-side terminal marker: the chain contract
+                # (obs.request) proves a shadow duplicate's life ends HERE
+                # and never as a caller-visible answer
+                hop_attrs["shadow"] = True
+            self._hop(r.rid, hop, **hop_attrs)
             self._cond.notify_all()
         return won
 
@@ -454,14 +476,13 @@ class ReplicaRouter:
         ids = self._tokenizer.encode_ids(text, self.buckets[-1])
         return self.submit_ids(ids, deadline_ms=deadline_ms)
 
-    def submit_ids(self, ids: List[int],
-                   deadline_ms: Optional[float] = None) -> _Request:
-        """Tiered admission + least-loaded dispatch; returns the future.
-
-        Raises :class:`QueueFullError` (hard-full, or no replica able to
-        take the request) or :class:`LoadShedError` (the shed tier dropped
-        the arrival itself: its deadline slack was the pool's lowest and
-        under the viability floor)."""
+    def make_request(self, ids: List[int],
+                     deadline_ms: Optional[float] = None) -> _Request:
+        """Build (but do NOT enqueue) a request in this pool's clock
+        domain: truncation, bucket pick and deadline stamping — the
+        :meth:`submit_ids` front half.  The fleet front door uses this to
+        mint the request id and record fleet-level hops (``degrade``,
+        ``shadow``) BEFORE a group pool admits the request."""
         if not ids:
             raise ValueError("empty request: submit at least one token id")
         if len(ids) > self.buckets[-1]:
@@ -474,6 +495,28 @@ class ReplicaRouter:
         req = _Request(ids, pick_bucket(len(ids), self.buckets), deadline)
         req.submitted = now  # _Request stamps time.monotonic; re-stamp in
         req.deadline = deadline  # the router's (injectable) clock domain
+        return req
+
+    def submit_ids(self, ids: List[int],
+                   deadline_ms: Optional[float] = None) -> _Request:
+        """Tiered admission + least-loaded dispatch; returns the future.
+
+        Raises :class:`QueueFullError` (hard-full, or no replica able to
+        take the request) or :class:`LoadShedError` (the shed tier dropped
+        the arrival itself: its deadline slack was the pool's lowest and
+        under the viability floor)."""
+        return self.submit_request(self.make_request(ids, deadline_ms),
+                                   deadline_ms=deadline_ms)
+
+    def submit_request(self, req: _Request,
+                       deadline_ms: Optional[float] = None) -> _Request:
+        """Admission + enqueue for a request :meth:`make_request` built
+        (the :meth:`submit_ids` back half, public so the fleet can route
+        ONE minted request into whichever model group the traffic policy
+        picks)."""
+        deadline_ms = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        shadow = {"shadow": True} if req.shadow_of is not None else {}
         with self._lock:
             if self._stop or not self._started:
                 raise RuntimeError("router is not running (call start())")
@@ -481,8 +524,8 @@ class ReplicaRouter:
             slot = self._pick_slot(exclude=None)
             if slot is None:
                 self.metrics.rejected_total.inc()
-                record_hop(self.tracer, req.rid, "rejected",
-                           reason="no-replica")
+                self._hop(req.rid, "rejected", reason="no-replica",
+                          **shadow)
                 raise QueueFullError("no replica available (all ejected?)")
             self._enqueue(slot, req)
             # ONE hop for admission + initial queue placement (the attrs
@@ -490,12 +533,12 @@ class ReplicaRouter:
             # deadline ride along so serve.replay can reconstruct the
             # arrival process (timestamps, lengths, deadlines) from the
             # recorded chains
-            record_hop(self.tracer, req.rid, "admit", tier=tier,
-                       replica=slot.index, tokens=len(req.ids),
-                       **({} if deadline_ms is None
-                          else {"deadline_ms": float(deadline_ms)}),
-                       **({"packed": True} if self.packed
-                          else {"bucket": req.bucket}))
+            self._hop(req.rid, "admit", tier=tier,
+                      replica=slot.index, tokens=len(req.ids),
+                      **({} if deadline_ms is None
+                         else {"deadline_ms": float(deadline_ms)}),
+                      **({"packed": True} if self.packed
+                         else {"bucket": req.bucket}))
             self.metrics.requests_total.inc()
             self._pending += 1
             self._pending_tokens += len(req.ids)
@@ -531,7 +574,12 @@ class ReplicaRouter:
                 self.metrics.backpressure_wait_ms.observe(
                     (time.monotonic() - t0) * 1e3)
                 continue  # re-evaluate: depth may have dropped OR grown
-            if tier == "shed":
+            if tier in ("shed", "degrade"):
+                # a pool reaching the degrade band with nothing behind it
+                # (no fleet, or a fleet with no cheap model) treats it as
+                # an early shed tier — the re-route decision belongs to
+                # the fleet front door, which consults admission_tier()
+                # BEFORE submitting here
                 self._shed_pass(arriving=req)
                 if req.done():  # the arrival itself was the doomed one
                     raise LoadShedError(
@@ -540,7 +588,9 @@ class ReplicaRouter:
                 return tier  # accepted at shed depth (its slack is viable)
             # tier == "reject"
             self.metrics.rejected_total.inc()
-            record_hop(self.tracer, req.rid, "rejected", tier="reject")
+            self._hop(req.rid, "rejected", tier="reject",
+                      **({"shadow": True} if req.shadow_of is not None
+                         else {}))
             raise QueueFullError(
                 f"queue full ({self._pending_units}/{adm.max_queue}"
                 + (" tokens)" if self.packed else ")"))
@@ -563,7 +613,9 @@ class ReplicaRouter:
         for r in victims:
             if r is arriving:
                 if r._complete(None, LoadShedError("shed on arrival")):
-                    record_hop(self.tracer, r.rid, "shed", arrival=True)
+                    self._hop(r.rid, "shed", arrival=True,
+                              **({"shadow": True}
+                                 if r.shadow_of is not None else {}))
                 self.metrics.shed_total.inc()
             else:
                 self._finish_locked(r, error=LoadShedError(
@@ -653,6 +705,19 @@ class ReplicaRouter:
                             # every snapshot request was requeued onto
                             # peers (they were still queued) — abandon the
                             # formed batch
+                            continue
+                        # a snapshot request that VANISHED from the queue
+                        # without completing was re-homed by the fleet's
+                        # rollback drain (extract_queued) while the batch
+                        # formed — executing it here would complete a
+                        # request another pool now owns and double-count
+                        # its pending slot.  Abandon; whatever is still
+                        # queued rides the next pack.  (Completed corpses
+                        # — shed/expired by the monitor — stay harmless:
+                        # their _finish is an idempotent no-op.)
+                        queued_ids = set(map(id, rep.pack_queue))
+                        if any(id(r) not in queued_ids and not r.done()
+                               for r in pb.requests):
                             continue
                         # reconcile: take exactly the packed requests out
                         # of the queue; anything the monitor completed
@@ -809,8 +874,8 @@ class ReplicaRouter:
                 # this batch formed — a dispatch hop recorded past its
                 # terminal would read as an incomplete chain
                 if not r.done():
-                    record_hop(tr, r.rid, "dispatch", replica=rep.index,
-                               bucket=bucket, row=i, retry=r.retries)
+                    self._hop(r.rid, "dispatch", replica=rep.index,
+                              bucket=bucket, row=i, retry=r.retries)
         rows = rep.flush_rows
         logits = rep.engine.infer_ids([r.ids for r in batch], bucket,
                                       rows=rows,
@@ -845,11 +910,11 @@ class ReplicaRouter:
             for r, (row, seg) in zip(pb.requests, pb.placements):
                 if r.done():  # completed elsewhere since the pack formed
                     continue
-                record_hop(tr, r.rid, "pack", replica=rep.index,
-                           row=row, slot=seg)
-                record_hop(tr, r.rid, "dispatch", replica=rep.index,
-                           row=row, slot=seg, packed=True,
-                           retry=r.retries)
+                self._hop(r.rid, "pack", replica=rep.index,
+                          row=row, slot=seg)
+                self._hop(r.rid, "dispatch", replica=rep.index,
+                          row=row, slot=seg, packed=True,
+                          retry=r.retries)
         logits = rep.engine.infer_packed(
             pb.arrays, segments=len(pb.requests),
             request_ids=[r.rid for r in pb.requests])
@@ -937,9 +1002,9 @@ class ReplicaRouter:
                     target.replica.queues[r.bucket].append(r)
                     target.metrics.queue_depth.set(target.replica.queued())
                     self.metrics.hedges_total.inc()
-                    record_hop(self.tracer, r.rid, "hedge",
-                               from_replica=rep.index,
-                               to_replica=target.index)
+                    self._hop(r.rid, "hedge",
+                              from_replica=rep.index,
+                              to_replica=target.index)
                     self._cond.notify_all()
 
     def _eject(self, index: int, reason: str) -> None:
@@ -998,9 +1063,9 @@ class ReplicaRouter:
                     self.metrics.requeued_total.inc()
                 slot.metrics.requeued_out.inc()
                 target.metrics.requeued_in.inc()
-                record_hop(self.tracer, r.rid, "requeue",
-                           from_replica=index, to_replica=target.index,
-                           inflight=was_inflight, packed=self.packed)
+                self._hop(r.rid, "requeue",
+                          from_replica=index, to_replica=target.index,
+                          inflight=was_inflight, packed=self.packed)
                 if self.packed:
                     # survivors RE-PACK the orphans: they join the
                     # target's token queue and ride its next packed batch
@@ -1113,7 +1178,7 @@ class ReplicaRouter:
     #: controller-side write can be funneled through the decision-recording
     #: ``_actuate`` choke point (jaxlint R13 flags any other path)
     KNOBS = ("hedge_ms", "max_wait_ms", "backpressure_at", "shed_at",
-             "shed_slack_ms")
+             "degrade_at", "shed_slack_ms")
 
     def apply_knob(self, name: str, value) -> None:
         """Set one tunable serving knob, thread-safely, effective for the
@@ -1126,10 +1191,13 @@ class ReplicaRouter:
                 self.hedge_ms = None if value is None else float(value)
             elif name == "max_wait_ms":
                 self.max_wait_ms = float(value)
-            elif name in ("backpressure_at", "shed_at"):
+            elif name in ("backpressure_at", "shed_at", "degrade_at"):
                 adm = self.admission
                 trial = {"backpressure_at": adm.backpressure_at,
-                         "shed_at": adm.shed_at, name: int(value)}
+                         "shed_at": adm.shed_at,
+                         "degrade_at": adm.degrade_at,
+                         name: (None if value is None and
+                                name == "degrade_at" else int(value))}
                 if not (0 <= trial["backpressure_at"] <= trial["shed_at"]
                         <= adm.max_queue):
                     raise ValueError(
@@ -1137,7 +1205,16 @@ class ReplicaRouter:
                         f"backpressure_at {trial['backpressure_at']} <= "
                         f"shed_at {trial['shed_at']} <= max_queue "
                         f"{adm.max_queue}")
-                setattr(adm, name, int(value))
+                if trial["degrade_at"] is not None and not (
+                        trial["backpressure_at"] <= trial["degrade_at"]
+                        <= trial["shed_at"]):
+                    raise ValueError(
+                        f"knob {name}={value} breaks tier ordering: "
+                        f"degrade_at {trial['degrade_at']} must sit "
+                        f"between backpressure_at "
+                        f"{trial['backpressure_at']} and shed_at "
+                        f"{trial['shed_at']}")
+                setattr(adm, name, trial[name])
             elif name == "shed_slack_ms":
                 self.admission.shed_slack_ms = float(value)
             else:
@@ -1152,7 +1229,72 @@ class ReplicaRouter:
                 "max_wait_ms": self.max_wait_ms,
                 "backpressure_at": self.admission.backpressure_at,
                 "shed_at": self.admission.shed_at,
+                "degrade_at": self.admission.degrade_at,
                 "shed_slack_ms": self.admission.shed_slack_ms}
+
+    # -------------------------------------------------------- fleet surface
+    def admission_tier(self) -> str:
+        """The ladder tier an arrival would meet RIGHT NOW — the fleet
+        front door consults this before submitting, so a ``degrade``-band
+        arrival can be re-routed to the cheap model instead of walking
+        into this pool's shed pass."""
+        with self._lock:
+            return self.admission.tier(self._pending_units)
+
+    def extract_queued(self) -> List[_Request]:
+        """Pull every queued (NOT in-flight) request out of this pool —
+        the fleet's canary-rollback drain.  Accounting is reconciled
+        (pending counts, gauges); in-flight batches finish where they are
+        (their callers get the answer that was already executing).  The
+        extracted requests are live futures the caller must re-home."""
+        with self._lock:
+            out: List[_Request] = []
+            seen: set = set()  # a hedged request lives in TWO queues
+            # a queued request whose twin is IN FLIGHT (a hedged
+            # duplicate racing its original) must not be re-homed: this
+            # pool is about to complete it, and handing it to another
+            # pool would charge two pending slots for one completion
+            inflight_ids = {id(r) for s in self._slots if s.replica
+                            for r in s.replica.inflight}
+            for s in self._slots:
+                rep = s.replica
+                if rep is None:
+                    continue
+                for q in rep.all_queues():
+                    out += [r for r in q if not r.done()
+                            and id(r) not in seen
+                            and id(r) not in inflight_ids]
+                    seen.update(map(id, q))
+                    q.clear()
+                s.metrics.queue_depth.set(0)
+            for r in out:
+                self._pending -= 1
+                self._pending_tokens -= len(r.ids)
+            self.metrics.queue_depth.set(self._pending)
+            self._cond.notify_all()
+            return out
+
+    def adopt(self, req: _Request) -> int:
+        """Enqueue an ALREADY-ADMITTED request (a fleet re-home: canary
+        rollback drains the candidate's queue into the primary pool) —
+        deliberately bypassing the admission ladder, because a rollback
+        must never turn accepted work into rejections.  Returns the slot
+        index; raises :class:`ReplicaFailedError` when no replica can
+        take it."""
+        with self._lock:
+            if self._stop or not self._started:
+                raise RuntimeError("router is not running (call start())")
+            slot = self._pick_slot(exclude=None)
+            if slot is None:
+                raise ReplicaFailedError(
+                    "no replica available to adopt the request")
+            self._enqueue(slot, req)
+            self._pending += 1
+            self._pending_tokens += len(req.ids)
+            self.metrics.requests_total.inc()
+            self.metrics.queue_depth.set(self._pending)
+            self._cond.notify_all()
+            return slot.index
 
     def deactivate_replica(self, index: Optional[int] = None) -> int:
         """Drain one healthy replica to a WARM STANDBY (control-plane
@@ -1213,10 +1355,10 @@ class ReplicaRouter:
                 self.metrics.requeued_total.inc()
                 slot.metrics.requeued_out.inc()
                 target.metrics.requeued_in.inc()
-                record_hop(self.tracer, r.rid, "requeue",
-                           from_replica=slot.index,
-                           to_replica=target.index, standby=True,
-                           inflight=False, packed=self.packed)
+                self._hop(r.rid, "requeue",
+                          from_replica=slot.index,
+                          to_replica=target.index, standby=True,
+                          inflight=False, packed=self.packed)
                 if self.packed:
                     target.replica.pack_queue.append(r)
                 else:
@@ -1309,6 +1451,12 @@ class ReplicaRouter:
             "active": self.active_count,
             "standby": self.standby_count,
         }
+
+    @property
+    def tokenizer(self):
+        """The pool's shared tokenizer (every replica encodes identically
+        — the fleet front door encodes once through this)."""
+        return self._tokenizer
 
     def engine(self, index: int = 0):
         """The live engine in slot ``index`` (current incarnation)."""

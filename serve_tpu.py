@@ -48,8 +48,23 @@ additionally records spans AND per-request hop chains (every request's
 admission → queue → dispatch → completion life is reconstructable by
 ``trace_tpu.py request <id>``).
 
-``--controller on`` (with ``--replicas N``) attaches the feedback control
-plane (:class:`~pdnlp_tpu.serve.controller.ServeController`): replica
+``--fleet "id=checkpoint:dtype:replicas[:role]"`` (comma-separated; roles
+``primary``/``candidate``/``cheap``) serves a **multi-model fleet**
+(:class:`~pdnlp_tpu.serve.fleet.FleetRouter`): one replica pool per model
+id behind one front door, with ``--shadow_fraction`` duplicating a
+sampled fraction of primary traffic onto the candidate (callers always
+get the primary's answer; argmax parity + latency deltas accumulate for
+the rollout law), ``--canary_fraction`` routing real traffic to the
+candidate, and a degrade admission band (``--degrade_at``, defaulted
+between backpressure and shed when a cheap model exists) re-routing
+overload to the cheap model instead of shedding it.  With ``--controller
+on`` and a candidate, the rollout law steps the canary fraction up while
+parity and p99 hold and auto-rolls it back (draining the candidate's
+queue to the primary) when either regresses (``--rollout off`` disables
+just the rollout law).
+
+``--controller on`` (with ``--replicas N`` or ``--fleet``) attaches the
+feedback control plane (:class:`~pdnlp_tpu.serve.controller.ServeController`): replica
 count (warm-standby scaling, never below ``--min_replicas``),
 ``hedge_ms``, flush age and admission thresholds track the live telemetry
 through a decision-recording, auto-reverting actuation path — controller
@@ -60,8 +75,9 @@ Serve-local flags (not ``Args`` fields): ``--checkpoint`` (default: newest
 under ``--output_dir``), ``--buckets 32,64,128``, ``--max_batch_size``,
 ``--max_wait_ms``, ``--max_queue``, ``--deadline_ms``, ``--replicas``,
 ``--hedge_ms``, ``--replica_stall_s``, ``--serve_pack``, ``--controller``,
-``--min_replicas``, ``--input``, ``--output``, ``--metrics_path``,
-``--no_mesh``.  Everything else (model, dtype, vocab, output_dir, ...) is
+``--min_replicas``, ``--fleet``, ``--shadow_fraction``,
+``--canary_fraction``, ``--degrade_at``, ``--rollout``, ``--input``,
+``--output``, ``--metrics_path``, ``--no_mesh``.  Everything else (model, dtype, vocab, output_dir, ...) is
 the standard ``Args`` CLI.
 """
 from __future__ import annotations
@@ -169,6 +185,86 @@ def build_router(args: Args, replicas: int, *,
         checkpoint_path=checkpoint, tracer=engines[0].tracer)
 
 
+def build_fleet(args: Args, specs, *, use_mesh: bool = True,
+                buckets=DEFAULT_BUCKETS, max_batch_size: int = 8,
+                max_wait_ms: float = 5.0, max_queue: int = 256,
+                deadline_ms: Optional[float] = None,
+                hedge_ms: Optional[float] = None,
+                stall_timeout: float = 10.0, serve_pack: str = "auto",
+                shadow_fraction: float = 0.0,
+                canary_fraction: float = 0.0,
+                degrade_at: Optional[int] = None):
+    """A multi-model fleet from ``--fleet`` :class:`ModelSpec` rows: one
+    :class:`ReplicaRouter` per model id (each spec's checkpoint/dtype/
+    replica count), composed by a :class:`FleetRouter` front door.
+
+    Placement mirrors :func:`build_router`, over the fleet's TOTAL
+    replica count: with enough devices every replica of every model gets
+    a private mesh slice; otherwise each is an independent plain-jit
+    engine.  The primary pool gets the degrade band (``degrade_at``,
+    defaulting to 5/8 of ``max_queue`` — between the backpressure and
+    shed defaults) only when a cheap model exists to absorb it."""
+    import dataclasses
+
+    import jax
+
+    from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, get_or_build_vocab
+    from pdnlp_tpu.serve import FleetRouter, ReplicaRouter
+
+    tok = WordPieceTokenizer(get_or_build_vocab(args))
+    total = sum(s.replicas for s in specs)
+    slices: list = [None] * total
+    if use_mesh:
+        from pdnlp_tpu.parallel import make_mesh
+
+        devices = list(jax.devices())
+        if args.num_devices:
+            devices = devices[: args.num_devices]
+        per = len(devices) // total
+        if per >= 1:
+            slices = [make_mesh(devices=devices[i * per:(i + 1) * per])
+                      for i in range(total)]
+
+    roles = {s.role: s.model_id for s in specs}
+    if degrade_at is None and "cheap" in roles:
+        degrade_at = (max_queue * 5) // 8
+    groups = {}
+    tracer = None
+    offset = 0
+    for spec in specs:
+        # each model serves at ITS declared precision — one Args copy per
+        # spec so the engines' serve_dtype (and the int8 quantized
+        # template) follow the fleet spec, not the global flag
+        sargs = dataclasses.replace(args, serve_dtype=spec.dtype)
+
+        def factory(index: int, _off=offset, _sargs=sargs):
+            return InferenceEngine(_sargs, tokenizer=tok,
+                                   mesh=slices[_off + index])
+
+        engines = [factory(i) for i in range(spec.replicas)]
+        tracer = tracer if tracer is not None else engines[0].tracer
+        rank0_print(f"fleet[{spec.model_id}] ({spec.role}): "
+                    f"{spec.replicas} replica(s) of "
+                    f"{spec.checkpoint or '<init weights>'} "
+                    f"[{spec.dtype}]", file=sys.stderr)
+        groups[spec.model_id] = ReplicaRouter(
+            engines, engine_factory=factory, buckets=buckets,
+            max_batch_size=max_batch_size, max_wait_ms=max_wait_ms,
+            max_queue=max_queue, default_deadline_ms=deadline_ms,
+            hedge_ms=hedge_ms, stall_timeout=stall_timeout,
+            serve_pack=serve_pack,
+            degrade_at=degrade_at if spec.role == "primary" else None,
+            pack_max_segments=getattr(args, "pack_max_segments", 16),
+            checkpoint_path=spec.checkpoint, model_id=spec.model_id,
+            tracer=tracer)
+        offset += spec.replicas
+    return FleetRouter(groups, primary=roles["primary"],
+                       candidate=roles.get("candidate"),
+                       cheap=roles.get("cheap"),
+                       shadow_fraction=shadow_fraction,
+                       canary_fraction=canary_fraction, tracer=tracer)
+
+
 class _ShutdownRequested(KeyboardInterrupt):
     """SIGTERM/SIGINT: stop intake, drain, flush — never drop silently."""
 
@@ -198,6 +294,13 @@ def main(argv=None) -> None:
     argv, serve_pack = pop_cli_flag(argv, "--serve_pack", "auto")
     argv, controller_mode = pop_cli_flag(argv, "--controller", "off")
     argv, min_replicas = pop_cli_flag(argv, "--min_replicas", 1, int)
+    argv, fleet_spec = pop_cli_flag(argv, "--fleet")
+    argv, shadow_fraction = pop_cli_flag(argv, "--shadow_fraction", 0.0,
+                                         float)
+    argv, canary_fraction = pop_cli_flag(argv, "--canary_fraction", 0.0,
+                                         float)
+    argv, degrade_at = pop_cli_flag(argv, "--degrade_at", None, int)
+    argv, rollout_mode = pop_cli_flag(argv, "--rollout", "auto")
     argv, in_path = pop_cli_flag(argv, "--input")
     argv, out_path = pop_cli_flag(argv, "--output")
     argv, metrics_path = pop_cli_flag(argv, "--metrics_path")
@@ -213,17 +316,41 @@ def main(argv=None) -> None:
     long_widths = tuple(int(w) for w in
                         str(args.serve_long_widths or "").split(",")
                         if str(w).strip())
-    if long_widths and replicas > 1:
+    if long_widths and (replicas > 1 or fleet_spec):
         sys.exit("serve_tpu: --serve_long_widths is the single-replica "
                  "DynamicBatcher path (chunked prefill); drop it or run "
-                 "--replicas 1")
+                 "--replicas 1 without --fleet")
 
     from pdnlp_tpu.data.corpus import id2label
 
     _install_signal_handlers()
 
+    if fleet_spec and in_path:
+        sys.exit("serve_tpu: --fleet is the online multi-model path; "
+                 "offline --input scoring serves ONE model — drop one")
+
     router = None
-    if replicas > 1 and not in_path:
+    fleet = None
+    if fleet_spec and not in_path:
+        # the multi-model fleet path: --fleet replaces --replicas (each
+        # spec names its own replica count); packed serving stays per
+        # group, shadow/canary/degrade ride the FleetRouter front door
+        from pdnlp_tpu.serve import parse_fleet_spec
+
+        if replicas > 1:
+            sys.exit("serve_tpu: --fleet and --replicas are exclusive — "
+                     "each fleet spec entry names its own replica count "
+                     "(id=checkpoint:dtype:replicas:role)")
+        fleet = build_fleet(
+            args, parse_fleet_spec(fleet_spec), use_mesh=not no_mesh,
+            buckets=buckets, max_batch_size=max_batch,
+            max_wait_ms=max_wait, max_queue=max_queue,
+            deadline_ms=deadline, hedge_ms=hedge_ms,
+            stall_timeout=stall_s, serve_pack=serve_pack,
+            shadow_fraction=shadow_fraction,
+            canary_fraction=canary_fraction, degrade_at=degrade_at)
+        engine = fleet.engine(0)  # metrics/tracer anchor
+    elif replicas > 1 and not in_path:
         router = build_router(
             args, replicas, checkpoint=checkpoint, use_mesh=not no_mesh,
             buckets=buckets, max_batch_size=max_batch, max_wait_ms=max_wait,
@@ -234,21 +361,28 @@ def main(argv=None) -> None:
         engine = build_engine(args, checkpoint=checkpoint,
                               use_mesh=not no_mesh)
 
-    # the feedback control plane rides the multi-replica router only (the
-    # knobs it actuates — replica count, hedge, admission tiers — only
-    # exist there); it starts AFTER warmup below so its first sense window
-    # never reads compile time as serving latency
+    pool = fleet if fleet is not None else router
+    # the feedback control plane rides the multi-replica router (or the
+    # fleet, whose primary group carries the same tuning surface — plus
+    # the rollout law when a candidate model is declared); it starts
+    # AFTER warmup below so its first sense window never reads compile
+    # time as serving latency
     controller = None
     if controller_mode not in ("off", "false", "0", None):
-        if router is None:
-            rank0_print("WARNING: --controller needs --replicas N > 1 "
-                        "(online mode) — running without a control plane",
-                        file=sys.stderr)
+        if pool is None:
+            rank0_print("WARNING: --controller needs --replicas N > 1 or "
+                        "--fleet (online mode) — running without a "
+                        "control plane", file=sys.stderr)
         else:
-            from pdnlp_tpu.serve.controller import ServeController
+            from pdnlp_tpu.serve.controller import RolloutPlan, ServeController
 
-            controller = ServeController(router,
+            rollout = None
+            if fleet is not None and fleet.candidate is not None \
+                    and rollout_mode not in ("off", "false", "0"):
+                rollout = RolloutPlan()
+            controller = ServeController(pool,
                                          min_replicas=min_replicas,
+                                         rollout=rollout,
                                          tracer=engine.tracer)
 
     # live telemetry (--metrics_port / --flight_recorder): Prometheus
@@ -259,18 +393,24 @@ def main(argv=None) -> None:
         from pdnlp_tpu.obs import memory_snapshot
         from pdnlp_tpu.obs.exporter import build_from_args
 
-        sources = ({"serve": router.snapshot} if router is not None
+        sources = ({"serve": pool.snapshot} if pool is not None
                    else {"serve": engine.metrics.snapshot,
                          "memory": engine.memory_snapshot})
-        if router is not None:
+        if pool is not None:
             sources["memory"] = memory_snapshot
         health = None
+        if fleet is not None:
+            # per-model role/traffic-split/parity at a glance on /healthz
+            # (the full per-model metric labels ride /metrics via the
+            # snapshot's `models` block)
+            health = {"fleet": fleet.health_summary}
         if controller is not None:
             # controller state on BOTH surfaces: full knob/hold/revert
             # detail as a /metrics source, the at-a-glance summary on
             # /healthz (the probe a load balancer reads)
             sources["controller"] = controller.snapshot
-            health = {"controller": controller.health_summary}
+            health = {**(health or {}),
+                      "controller": controller.health_summary}
         exporter = build_from_args(args, sources, "flight_serve.jsonl",
                                    health_sources=health)
         if exporter is not None and exporter.port is not None:
@@ -285,7 +425,7 @@ def main(argv=None) -> None:
 
         if exporter is not None:
             exporter.stop(final_flight=True)  # last flight line first
-        snap = router.snapshot() if router is not None \
+        snap = pool.snapshot() if pool is not None \
             else {**engine.metrics.snapshot(),
                   "memory": engine.memory_snapshot()}
         if extra:
@@ -321,10 +461,11 @@ def main(argv=None) -> None:
             flush_artifacts()
         return
 
-    # online: stdin lines through the dynamic batcher (or the router)
-    if router is not None:
-        frontend = router.start()
-        if not router.wait_ready():
+    # online: stdin lines through the dynamic batcher (or the router /
+    # the fleet — both carry the same start/wait_ready/submit surface)
+    if pool is not None:
+        frontend = pool.start()
+        if not pool.wait_ready():
             frontend.stop(drain=False)
             sys.exit("serve_tpu: no replica finished warmup — the pool is "
                      "dead (corrupt checkpoint? every worker's warm load "
@@ -369,10 +510,14 @@ def main(argv=None) -> None:
     # tokens when inputs run long — an uncapped window would walk every
     # submission into the reject tier on a long-text workload the padded
     # path serves fine
-    if router is not None:
-        rows = router.engine(0).pad_rows(max_batch)
-        per_replica = rows * (router.pack_segments if router.packed else 1)
-        window = min(2 * replicas * per_replica, max_queue)
+    if pool is not None:
+        # the fleet's window is sized to its PRIMARY pool (caller traffic
+        # lands there; candidate/cheap absorb policy-routed overflow)
+        group = fleet.groups[fleet.primary] if fleet is not None else router
+        n_rep = len(group._slots)
+        rows = group.engine(0).pad_rows(max_batch)
+        per_replica = rows * (group.pack_segments if group.packed else 1)
+        window = min(2 * n_rep * per_replica, max_queue)
     else:
         window = min(2 * frontend.max_batch_size
                      * (frontend.pack_segments if frontend.packed else 1),

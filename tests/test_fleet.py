@@ -1,0 +1,583 @@
+"""Multi-model fleet tests: the degrade admission band, shadow traffic
+that can never leak a candidate answer, the controller's canary-rollout
+law (advance on parity evidence, auto-rollback on regression), the
+rollback drain, per-model metrics reconciliation, the new hop-chain
+rules (one test per malformed variant), per-model Prometheus labels, and
+one real-engine bf16-vs-int8 two-model parity pass."""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pdnlp_tpu.obs.exporter import MetricsExporter  # noqa: E402
+from pdnlp_tpu.obs.request import (  # noqa: E402
+    chain_issues, chains, validate_chains,
+)
+from pdnlp_tpu.obs.trace import Tracer  # noqa: E402
+from pdnlp_tpu.serve import (  # noqa: E402
+    AdmissionControl, FleetRouter, LoadShedError, QueueFullError,
+    ReplicaRouter, RolloutPlan, ServeController, parse_fleet_spec,
+)
+from pdnlp_tpu.serve.controller import KnobSpec, default_specs  # noqa: E402
+
+from tests.test_controller import NO_SCALE, FakeRouter, _tick  # noqa: E402
+from tests.test_elastic import FakeClock  # noqa: E402
+from tests.test_router import FakeEngine  # noqa: E402
+
+
+def _group(mid, tracer, n=1, engines=None, **kw):
+    engines = engines or [FakeEngine() for _ in range(n)]
+    kw.setdefault("buckets", (32, 64))
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_wait_ms", 2.0)
+    kw.setdefault("stall_timeout", 10.0)
+    kw.setdefault("poll_interval", 0.02)
+    kw.setdefault("max_queue", 256)
+    return ReplicaRouter(engines, model_id=mid, tracer=tracer, **kw)
+
+
+def _argmax_engine(label_idx, num_labels=6):
+    """A FakeEngine whose every answer argmaxes at ``label_idx`` — so a
+    leaked answer is detectable by its class."""
+    e = FakeEngine(num_labels=num_labels)
+    e.infer_ids = lambda id_lists, seq, rows=0, request_ids=None: \
+        np.eye(num_labels, dtype=np.float32)[
+            np.full(len(id_lists), label_idx)] * 7.0
+    return e
+
+
+# --------------------------------------------------------- admission band
+def test_admission_ladder_walks_all_five_tiers_on_fake_clock():
+    clk = FakeClock()
+    adm = AdmissionControl(16, backpressure_at=8, degrade_at=10,
+                           shed_at=12, shed_slack_ms=10.0, clock=clk)
+    assert [adm.tier(n) for n in (0, 7, 8, 9, 10, 11, 12, 15, 16)] == [
+        "healthy", "healthy", "backpressure", "backpressure", "degrade",
+        "degrade", "shed", "shed", "reject"]
+    # without the band the ladder is the pre-fleet 4-tier one
+    adm4 = AdmissionControl(16, backpressure_at=8, shed_at=12, clock=clk)
+    assert adm4.tier(10) == "backpressure"
+    with pytest.raises(ValueError):  # band must sit between bp and shed
+        AdmissionControl(16, backpressure_at=8, degrade_at=13, shed_at=12)
+    with pytest.raises(ValueError):
+        AdmissionControl(16, backpressure_at=8, degrade_at=4, shed_at=12)
+
+
+def test_degrade_band_reroutes_to_cheap_with_hop_before_dispatch():
+    """An overload burst against a tight primary ladder: degrade-band
+    arrivals land on the cheap model (and get ITS answer), every degraded
+    chain carries the degrade hop before its dispatch, and the primary
+    never reaches its shed tier."""
+    tracer = Tracer(enabled=True)
+    prim = _group("prod", tracer, engines=[_argmax_engine(0)],
+                  max_batch_size=100, max_wait_ms=25.0, max_queue=16,
+                  backpressure_at=6, degrade_at=8, shed_at=12,
+                  backpressure_wait_ms=1.0, shed_slack_ms=120_000.0)
+    cheap = _group("tiny", tracer, engines=[_argmax_engine(3)],
+                   max_batch_size=100, max_wait_ms=25.0)
+    fleet = FleetRouter({"prod": prim, "tiny": cheap}, primary="prod",
+                        cheap="tiny", tracer=tracer).start()
+    assert fleet.wait_ready(10)
+    try:
+        futs = [fleet.submit_ids([2, 3, 4], deadline_ms=60_000)
+                for _ in range(24)]
+        outs = [int(np.argmax(f.result(timeout=10))) for f in futs]
+    finally:
+        fleet.stop()
+    degraded = fleet.metrics.degraded_total.value
+    assert degraded >= 1
+    assert prim.metrics.shed_total.value == 0
+    assert fleet.metrics.requests_total.value == 24
+    # degraded callers got the CHEAP model's answer; the rest the primary's
+    assert outs.count(3) == degraded and outs.count(0) == 24 - degraded
+    report = validate_chains(tracer.records())
+    assert not report["incomplete"]
+    assert report["degraded"] == degraded
+    # per-model reconciliation: the cheap pool admitted exactly the
+    # degraded traffic, the primary everything else
+    assert cheap.metrics.requests_total.value == degraded
+    assert prim.metrics.requests_total.value == 24 - degraded
+
+
+def test_degrade_without_cheap_falls_through_to_shed_loudly(capsys):
+    tracer = Tracer(enabled=True)
+    prim = _group("prod", tracer, max_batch_size=100,
+                  max_wait_ms=60_000.0, max_queue=16, backpressure_at=2,
+                  degrade_at=2, shed_at=12, backpressure_wait_ms=1.0,
+                  shed_slack_ms=120_000.0)
+    fleet = FleetRouter({"prod": prim}, primary="prod", tracer=tracer)
+    prim._started = True  # white-box: queue mechanics only
+    fleet.submit_ids([2, 3], deadline_ms=30_000)
+    fleet.submit_ids([2, 3], deadline_ms=30_000)
+    # depth 2 = the degrade band; with no cheap model the arrival falls
+    # through to the group ladder, whose shed pass drops the doomed
+    with pytest.raises(LoadShedError):
+        fleet.submit_ids([2, 3], deadline_ms=30_000)
+    assert fleet.metrics.degrade_fallthrough_total.value >= 1
+    assert fleet.metrics.degraded_total.value == 0
+    assert "NO cheap model" in capsys.readouterr().err
+
+
+def test_fleet_rejects_at_hard_full_and_validates_spec():
+    tracer = Tracer(enabled=False)
+    prim = _group("prod", tracer, max_batch_size=100,
+                  max_wait_ms=60_000.0, max_queue=2, backpressure_at=2,
+                  shed_at=2, backpressure_wait_ms=0.5)
+    fleet = FleetRouter({"prod": prim}, primary="prod", tracer=tracer)
+    prim._started = True
+    fleet.submit_ids([2, 3])
+    fleet.submit_ids([2, 3])
+    with pytest.raises(QueueFullError):
+        fleet.submit_ids([2, 3])
+    # construction-time validation
+    with pytest.raises(ValueError):
+        FleetRouter({"prod": prim}, primary="missing")
+    with pytest.raises(ValueError):
+        FleetRouter({"prod": prim}, primary="prod", candidate="prod")
+    with pytest.raises(ValueError):  # groups must carry their fleet key
+        FleetRouter({"other": prim}, primary="other")
+    with pytest.raises(ValueError):  # canary needs a candidate
+        FleetRouter({"prod": prim}, primary="prod", canary_fraction=0.5)
+
+
+def test_parse_fleet_spec_roles_and_errors():
+    specs = parse_fleet_spec(
+        "prod=a.msgpack:bf16:2,next=b.msgpack::1:candidate,"
+        "tiny=a.int8.msgpack:int8:1:cheap")
+    assert [(s.model_id, s.role, s.dtype, s.replicas) for s in specs] == [
+        ("prod", "primary", "bf16", 2), ("next", "candidate", "auto", 1),
+        ("tiny", "cheap", "int8", 1)]
+    with pytest.raises(ValueError):  # second entry must name a role
+        parse_fleet_spec("a=x.msgpack,b=y.msgpack")
+    with pytest.raises(ValueError):  # two primaries
+        parse_fleet_spec("a=x.msgpack,b=y.msgpack:::primary")
+    with pytest.raises(ValueError):  # duplicate ids
+        parse_fleet_spec("a=x.msgpack,a=y.msgpack:::cheap")
+    with pytest.raises(ValueError):  # unknown role
+        parse_fleet_spec("a=x.msgpack:::boss")
+    with pytest.raises(ValueError):  # bad dtype
+        parse_fleet_spec("a=x.msgpack:fp8")
+
+
+# ----------------------------------------------------------- shadow traffic
+def test_shadow_never_leaks_the_candidate_answer():
+    """First-wins on the caller's future is primary-only by construction:
+    the shadow is a SEPARATE request — with every request duplicated onto
+    a candidate that answers a different class, every caller still gets
+    the primary's class, and the mismatches land in the ShadowReport."""
+    tracer = Tracer(enabled=True)
+    prim = _group("prod", tracer, engines=[_argmax_engine(0)])
+    cand = _group("cand", tracer, engines=[_argmax_engine(1)])
+    fleet = FleetRouter({"prod": prim, "cand": cand}, primary="prod",
+                        candidate="cand", shadow_fraction=1.0,
+                        tracer=tracer).start()
+    assert fleet.wait_ready(10)
+    try:
+        futs = [fleet.submit_ids([2, 3, 4], deadline_ms=30_000)
+                for _ in range(10)]
+        outs = [int(np.argmax(f.result(timeout=10))) for f in futs]
+        deadline = time.monotonic() + 10
+        while fleet.shadow_report.parity_checked < 10 \
+                and time.monotonic() < deadline:
+            fleet._harvest_once()
+            time.sleep(0.02)
+    finally:
+        fleet.stop()
+    assert outs == [0] * 10  # the candidate's class 1 never leaked
+    rep = fleet.shadow_report
+    assert rep.parity_checked == 10 and rep.mismatches == 10
+    assert fleet.metrics.shadows_total.value == 10
+    # every shadow chain terminates shadow-side (shadow=True terminal)
+    report = validate_chains(tracer.records())
+    assert not report["incomplete"]
+    assert report["shadowed"] == 10
+    assert report["checked"] == 20  # 10 callers + 10 duplicates
+
+
+def test_shadow_fraction_sampling_is_exact():
+    tracer = Tracer(enabled=False)
+    prim = _group("prod", tracer, max_batch_size=100,
+                  max_wait_ms=60_000.0)
+    cand = _group("cand", tracer, max_batch_size=100,
+                  max_wait_ms=60_000.0)
+    fleet = FleetRouter({"prod": prim, "cand": cand}, primary="prod",
+                        candidate="cand", shadow_fraction=0.25,
+                        tracer=tracer)
+    prim._started = True
+    cand._started = True
+    for _ in range(40):
+        fleet.submit_ids([2, 3], deadline_ms=60_000)
+    # the deterministic accumulator promises exactly floor(0.25 * 40)
+    assert fleet.metrics.shadows_total.value == 10
+    assert cand._pending == 10  # duplicates queue on the candidate only
+
+
+# ------------------------------------------------------- canary rollout law
+class FakeFleet(FakeRouter):
+    """Fleet-shaped double: the FakeRouter tuning surface plus the
+    rollout surface (`rollout_sense`, the traffic-fraction knobs, and a
+    recorded rollback drain on fraction -> 0)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.knobs["canary_fraction"] = 0.0
+        self.knobs["shadow_fraction"] = 0.5
+        self.sense = {"parity_checked": 50, "mismatch_rate": 0.0,
+                      "shadow_failed": 0, "primary_p99_ms": 20.0,
+                      "candidate_p99_ms": 21.0}
+        self.rollback_drains = 0
+
+    def apply_knob(self, name, value):
+        if name == "canary_fraction":
+            old = self.knobs["canary_fraction"]
+            self.knobs["canary_fraction"] = value
+            self.applied.append((name, value))
+            if value == 0.0 and old > 0.0:
+                self.rollback_drains += 1
+            return
+        super().apply_knob(name, value)
+
+    def rollout_sense(self):
+        return {"canary_fraction": self.knobs["canary_fraction"],
+                "shadow_fraction": self.knobs["shadow_fraction"],
+                **self.sense}
+
+
+def _rollout_controller(plan=None, **sense):
+    fleet = FakeFleet()
+    fleet.sense.update(sense)
+    clk = FakeClock()
+    plan = plan or RolloutPlan(steps=(0.1, 0.5, 1.0),
+                               min_shadow_checked=5, patience=2,
+                               p99_factor=1.5, p99_floor_ms=5.0)
+    c = ServeController(fleet, clock=clk, tracer=fleet.tracer,
+                        rollout=plan, eval_window_s=5.0,
+                        revert_margin=10.0, **NO_SCALE)
+    assert c.step() is None  # prime the counter deltas
+    clk.advance(1.0)
+    return c, fleet, clk
+
+
+def test_rollout_advances_stepwise_on_clean_evidence():
+    c, fleet, clk = _rollout_controller()
+    for _ in range(30):
+        _tick(c, clk)
+    advances = [v for k, v in fleet.applied if k == "canary_fraction"]
+    assert advances == [0.1, 0.5, 1.0]  # every step, in order, no skips
+    assert fleet.knobs["canary_fraction"] == 1.0
+    assert c.rollbacks_total == 0 and fleet.rollback_drains == 0
+
+
+def test_rollout_waits_for_parity_evidence():
+    c, fleet, clk = _rollout_controller(parity_checked=0)
+    for _ in range(10):
+        _tick(c, clk)
+    assert fleet.knobs["canary_fraction"] == 0.0  # no evidence, no move
+    fleet.sense["parity_checked"] = 50
+    for _ in range(5):
+        _tick(c, clk)
+    assert fleet.knobs["canary_fraction"] > 0.0
+
+
+def test_rollout_rolls_back_on_parity_regression_and_stays_down():
+    c, fleet, clk = _rollout_controller()
+    for _ in range(12):
+        _tick(c, clk)
+    assert fleet.knobs["canary_fraction"] >= 0.5
+    fleet.sense["mismatch_rate"] = 0.3  # the candidate started lying
+    _tick(c, clk)
+    assert fleet.knobs["canary_fraction"] == 0.0
+    assert c.rollbacks_total == 1 and fleet.rollback_drains == 1
+    # the evidence clears, but a condemned candidate stays rolled back
+    fleet.sense["mismatch_rate"] = 0.0
+    for _ in range(10):
+        _tick(c, clk)
+    assert fleet.knobs["canary_fraction"] == 0.0
+    assert c.rollbacks_total == 1
+    # decision chains stay complete (the rollback is chained, its eval
+    # window resolves at stop) and the rollback can never be "reverted"
+    c.stop()
+    from pdnlp_tpu.obs.decision import decision_chains, validate_decisions
+    report = validate_decisions(fleet.tracer.records())
+    assert not report["incomplete"]
+    rollback = [ch for ch in decision_chains(
+        fleet.tracer.records()).values()
+        if any(a.get("attrs", {}).get("knob") == "canary_fraction"
+               and a.get("attrs", {}).get("new") == 0.0 for a in ch)]
+    assert rollback and any(
+        a.get("attrs", {}).get("revert_of") for ch in rollback for a in ch)
+
+
+def test_rollout_rolls_back_on_candidate_p99_regression():
+    c, fleet, clk = _rollout_controller()
+    for _ in range(6):
+        _tick(c, clk)
+    assert fleet.knobs["canary_fraction"] > 0.0
+    fleet.sense["candidate_p99_ms"] = 200.0  # 10x the primary
+    _tick(c, clk)
+    assert fleet.knobs["canary_fraction"] == 0.0
+    assert c.rollbacks_total == 1
+
+
+def test_stale_advance_eval_never_reinstalls_a_rolled_back_canary():
+    """A pending eval of an EARLIER advance (old=0.1) coming due after
+    the law force-rolled the fraction to 0 must resolve ``superseded``,
+    never "revert" caller traffic back onto the condemned candidate."""
+    c, fleet, clk = _rollout_controller()
+    fleet.p99 = 20.0  # a live baseline so advance evals CAN regress
+    for _ in range(8):  # advance 0 -> 0.1 -> 0.25 (two pending evals)
+        _tick(c, clk)
+    assert fleet.knobs["canary_fraction"] == 0.5
+    fleet.sense["mismatch_rate"] = 0.5  # parity regression -> rollback
+    fleet.p99 = 500.0  # ambient signal regresses too: without the
+    _tick(c, clk)      # staleness guard the stale advance eval would
+    #                    now "revert" to its old non-zero fraction
+    assert fleet.knobs["canary_fraction"] == 0.0
+    for _ in range(10):
+        _tick(c, clk)
+    assert fleet.knobs["canary_fraction"] == 0.0
+    assert c.rollbacks_total == 1
+    # the trailing canary actuation is the rollback itself — nothing
+    # ever re-installed a fraction after it
+    fractions = [v for k, v in fleet.applied if k == "canary_fraction"]
+    assert fractions[-1] == 0.0 and 0.0 not in fractions[:-1]
+
+
+def test_extract_queued_skips_inflight_hedged_duplicates():
+    """The rollback drain must not re-home a queued hedge copy whose
+    original is executing HERE: this pool completes it, and handing it
+    to another pool would charge two pending slots for one answer."""
+    tracer = Tracer(enabled=False)
+    g = _group("cand", tracer, n=2, max_batch_size=100,
+               max_wait_ms=60_000.0)
+    g._started = True
+    r1 = g.submit_ids([2, 3], deadline_ms=60_000)
+    r2 = g.submit_ids([2, 3], deadline_ms=60_000)
+    # white-box hedge shape: r1's original is IN FLIGHT on replica 0,
+    # its duplicate queued on replica 1
+    rep0, rep1 = g._slots[0].replica, g._slots[1].replica
+    for q in rep0.all_queues() + rep1.all_queues():
+        q[:] = [r for r in q if r is not r1]
+    r1.hedged = True
+    rep0.inflight = [r1]
+    rep1.queues[r1.bucket].append(r1)
+    drained = g.extract_queued()
+    assert drained == [r2]      # the hedge copy stayed with its pool
+    assert g._pending == 1      # r1's slot still charged HERE, once
+
+
+def test_canary_routed_counts_only_accepted_candidate_traffic():
+    tracer = Tracer(enabled=False)
+    prim = _group("prod", tracer, max_batch_size=100,
+                  max_wait_ms=60_000.0)
+    cand = _group("cand", tracer, max_batch_size=100,
+                  max_wait_ms=60_000.0, max_queue=2, backpressure_at=2,
+                  shed_at=2)
+    fleet = FleetRouter({"prod": prim, "cand": cand}, primary="prod",
+                        candidate="cand", canary_fraction=1.0,
+                        tracer=tracer)
+    prim._started = True
+    cand._started = True
+    fleet.submit_ids([2, 3])
+    fleet.submit_ids([2, 3])
+    with pytest.raises(QueueFullError):  # the candidate's door refused
+        fleet.submit_ids([2, 3])
+    assert fleet.metrics.canary_routed_total.value == 2  # not 3
+
+
+def test_rollback_drain_rehomes_queued_candidate_requests():
+    """Fraction -> 0 mid-rollout: everything queued on the candidate
+    moves to the primary with a ``rollback`` hop and still completes
+    exactly once — with the PRIMARY's answer."""
+    tracer = Tracer(enabled=True)
+    prim = _group("prod", tracer, engines=[_argmax_engine(0)],
+                  max_batch_size=100, max_wait_ms=60_000.0)
+    cand = _group("cand", tracer, engines=[_argmax_engine(1)],
+                  max_batch_size=100, max_wait_ms=60_000.0)
+    fleet = FleetRouter({"prod": prim, "cand": cand}, primary="prod",
+                        candidate="cand", canary_fraction=0.5,
+                        tracer=tracer).start()
+    assert fleet.wait_ready(10)
+    try:
+        futs = [fleet.submit_ids([2, 3], deadline_ms=60_000)
+                for _ in range(10)]
+        assert fleet.metrics.canary_routed_total.value == 5
+        fleet.apply_knob("canary_fraction", 0.0)
+        assert fleet.metrics.rollbacks_total.value == 1
+        rolled = fleet.metrics.rolled_back_requests_total.value
+        # nothing flushes at a 60s age: whatever the candidate had not
+        # dispatched came back; open the flush gate and everything
+        # completes on the primary
+        prim.apply_knob("max_wait_ms", 1.0)
+        outs = [int(np.argmax(f.result(timeout=10))) for f in futs]
+    finally:
+        fleet.stop()
+    assert rolled >= 1
+    assert outs.count(1) == 5 - rolled  # candidate kept only in-flight
+    assert outs.count(0) == 5 + rolled
+    report = validate_chains(tracer.records())
+    assert not report["incomplete"]
+    assert report["rolled_back"] == rolled
+
+
+# --------------------------------------------------- chain-integrity rules
+def _hop(hop, t, **attrs):
+    return {"name": "hop", "t0": t,
+            "attrs": {"request_id": "r1-1", "hop": hop, **attrs}}
+
+
+def test_chain_rules_shadow_must_terminate_shadow_side():
+    good = [_hop("shadow", 0.0, of="r1-0"), _hop("admit", 1.0),
+            _hop("dispatch", 2.0), _hop("complete", 3.0, shadow=True)]
+    assert chain_issues(good) == []
+    leak = [_hop("shadow", 0.0, of="r1-0"), _hop("admit", 1.0),
+            _hop("dispatch", 2.0), _hop("complete", 3.0)]
+    assert any("CALLER-VISIBLE" in i for i in chain_issues(leak))
+    # a shadow refused at the candidate's door is complete too
+    refused = [_hop("shadow", 0.0, of="r1-0"),
+               _hop("rejected", 1.0, shadow=True)]
+    assert chain_issues(refused) == []
+    headless = [_hop("shadow", 0.0, of="r1-0"),
+                _hop("dispatch", 1.0), _hop("complete", 2.0, shadow=True)]
+    assert any("not followed by 'admit'" in i
+               for i in chain_issues(headless))
+
+
+def test_chain_rules_degrade_precedes_dispatch():
+    good = [_hop("degrade", 0.0, from_model="prod", to_model="tiny"),
+            _hop("admit", 1.0, model="tiny"), _hop("dispatch", 2.0),
+            _hop("complete", 3.0)]
+    assert chain_issues(good) == []
+    late = [_hop("admit", 0.0), _hop("dispatch", 1.0),
+            _hop("degrade", 2.0), _hop("complete", 3.0)]
+    assert any("after a dispatch" in i for i in chain_issues(late))
+    headless = [_hop("degrade", 0.0), _hop("dispatch", 1.0),
+                _hop("complete", 2.0)]
+    assert any("not followed by 'admit'" in i
+               for i in chain_issues(headless))
+
+
+def test_chain_rules_rollback_is_not_terminal_and_not_benign_tail():
+    good = [_hop("admit", 0.0, model="cand"), _hop("rollback", 1.0),
+            _hop("dispatch", 2.0), _hop("complete", 3.0)]
+    assert chain_issues(good) == []
+    orphan = [_hop("admit", 0.0), _hop("rollback", 1.0)]
+    assert any("no terminal" in i for i in chain_issues(orphan))
+    stray = [_hop("admit", 0.0), _hop("complete", 1.0),
+             _hop("rollback", 2.0)]
+    assert any("after the terminal" in i for i in chain_issues(stray))
+    double = [_hop("admit", 0.0), _hop("rollback", 1.0),
+              _hop("complete", 2.0), _hop("complete", 3.0)]
+    assert any("2 terminal hops" in i for i in chain_issues(double))
+
+
+# --------------------------------------------------- per-model export
+def test_exporter_scrapes_per_model_labels():
+    """The fleet snapshot's ``models`` block renders as a ``model`` label
+    — one scrape distinguishes primary/candidate/cheap queue depth, p99
+    and the shadow parity counters."""
+    tracer = Tracer(enabled=False)
+    prim = _group("prod", tracer, max_batch_size=100,
+                  max_wait_ms=60_000.0)
+    cand = _group("cand", tracer, max_batch_size=100,
+                  max_wait_ms=60_000.0)
+    fleet = FleetRouter({"prod": prim, "cand": cand}, primary="prod",
+                        candidate="cand", shadow_fraction=1.0,
+                        tracer=tracer)
+    prim._started = True
+    cand._started = True
+    for _ in range(3):
+        fleet.submit_ids([2, 3], deadline_ms=60_000)
+    fleet.shadow_report.observe(True, 10.0, 12.0)
+    fleet.shadow_report.observe(False, 10.0, 40.0)
+    ex = MetricsExporter({"serve": fleet.snapshot},
+                         health_sources={"fleet": fleet.health_summary},
+                         port=0).start()
+    try:
+        base = f"http://127.0.0.1:{ex.port}"
+        body = urllib.request.urlopen(base + "/metrics",
+                                      timeout=5).read().decode()
+        hz = json.loads(urllib.request.urlopen(base + "/healthz",
+                                               timeout=5).read())
+    finally:
+        ex.stop(final_flight=False)
+    assert 'pdnlp_serve_models_router_queue_depth{model="prod"} 3' in body
+    assert 'pdnlp_serve_models_router_queue_depth{model="cand"} 3' in body
+    assert 'model="cand"' in body and "request_latency_ms" in body
+    # per-replica labels still nest under each model
+    assert ('pdnlp_serve_models_replicas_queue_depth'
+            '{model="prod",replica="0"}') in body
+    # shadow parity counters ride the same scrape
+    assert "pdnlp_serve_shadow_mismatches 1" in body
+    assert "pdnlp_serve_fleet_shadows_total 3" in body
+    # /healthz summarizes roles + the live split
+    assert hz["fleet"]["models"]["prod"]["role"] == "primary"
+    assert hz["fleet"]["shadow"]["parity_checked"] == 2
+
+
+# --------------------------------------------------- real engines (2-model)
+def test_real_engine_two_model_bf16_int8_parity(tmp_path):
+    """One real pass: a bf16 primary and an int8 candidate serving the
+    SAME checkpoint behind one fleet — full shadowing, argmax parity
+    within the int8 tolerance, zero retraces, all chains complete."""
+    import dataclasses
+
+    import jax
+
+    from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
+    from pdnlp_tpu.serve import InferenceEngine
+    from pdnlp_tpu.train import checkpoint as ckpt
+    from pdnlp_tpu.utils.config import Args
+
+    texts = ["天地人你我", "好坏大小上下来去", "高兴悲伤讨厌", "爱恨喜怒"]
+    tok = WordPieceTokenizer(build_vocab(texts, size=128))
+    args = Args(model="bert-tiny", trace=True,
+                trace_dir=str(tmp_path / "trace"))
+    e_bf16 = InferenceEngine(args, tokenizer=tok, mesh=None)
+    e_int8 = InferenceEngine(
+        dataclasses.replace(args, serve_dtype="int8"), tokenizer=tok,
+        mesh=None)
+    tracer = e_bf16.tracer
+    ck = str(tmp_path / "fleet-cls.msgpack")
+    ckpt.save(ck, jax.device_get(e_bf16.params))
+
+    def mk(mid, eng):
+        return ReplicaRouter([eng], buckets=(32,), max_batch_size=2,
+                             max_wait_ms=5.0, stall_timeout=10.0,
+                             poll_interval=0.05, checkpoint_path=ck,
+                             model_id=mid, tracer=tracer)
+
+    prim, cand = mk("bf16", e_bf16), mk("int8", e_int8)
+    fleet = FleetRouter({"bf16": prim, "int8": cand}, primary="bf16",
+                        candidate="int8", shadow_fraction=1.0,
+                        tracer=tracer).start()
+    assert fleet.wait_ready(300)
+    try:
+        futs = [fleet.submit(texts[i % len(texts)], deadline_ms=60_000)
+                for i in range(12)]
+        outs = [f.result(timeout=60) for f in futs]
+        assert all(o.shape == (6,) for o in outs)
+        deadline = time.monotonic() + 30
+        while fleet.shadow_report.checked < 12 \
+                and time.monotonic() < deadline:
+            fleet._harvest_once()
+            time.sleep(0.05)
+    finally:
+        fleet.stop()
+    rep = fleet.shadow_report
+    assert rep.parity_checked == 12 and rep.shadow_failed == 0
+    # int8-vs-bf16 argmax agreement (the kernel-smoke bound is >= 95%
+    # over a large corpus; 12 requests over 4 texts must agree fully or
+    # nearly — allow one quantization flip)
+    assert rep.mismatches <= 1
+    assert fleet.retraces_post_warmup == 0
+    report = validate_chains(tracer.records())
+    assert not report["incomplete"]
+    assert report["shadowed"] == 12

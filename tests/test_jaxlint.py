@@ -322,6 +322,46 @@ def test_r14_hint_names_the_routed_alternative():
     assert "segment_ids" in f.hint and "ops.attention" in f.hint
 
 
+def test_r15_unrecorded_traffic_shift_positive():
+    # direct canary-fraction write (7), augmented shadow-fraction write
+    # (11), raw rollback drain (15), raw extract/adopt re-home (19, 20) —
+    # each outside _actuate/_apply/apply_knob in a fleet-scope module
+    assert all_hits("r15_pos.py") == [("R15", 7), ("R15", 11),
+                                      ("R15", 15), ("R15", 19),
+                                      ("R15", 20)]
+
+
+def test_r15_unrecorded_traffic_shift_negative():
+    assert hits("r15_neg.py", "R15") == []
+
+
+def test_r15_requires_fleet_context(tmp_path):
+    """The fleet module itself owns the fractions (its __init__/apply_knob
+    ARE the setter surface — the R13 router precedent), and a module that
+    never imports the fleet has no rollout state to shift."""
+    p = tmp_path / "plain.py"
+    p.write_text("def build(thing):\n"
+                 "    thing.canary_fraction = 0.5\n"
+                 "    thing.extract_queued()\n")
+    assert [f for f in analyze_paths([str(p)], root=str(tmp_path))
+            if f.rule_id == "R15"] == []
+
+
+def test_r15_fleet_module_itself_out_of_scope():
+    """pdnlp_tpu/serve/fleet.py writes its own fractions in __init__ and
+    apply_knob/_rollback_drain — the sanctioned setter surface."""
+    path = os.path.join(REPO, "pdnlp_tpu", "serve", "fleet.py")
+    assert [f for f in analyze_paths([path], root=REPO)
+            if f.rule_id == "R15"] == []
+
+
+def test_r15_hint_names_the_choke_point():
+    path = os.path.join(FIXTURES, "r15_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R15"][0]
+    assert "_actuate" in f.hint and "canary_fraction" in f.hint
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -331,10 +371,10 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    # the registry sorts by id STRING (R10..R14 between R1 and R2)
+    # the registry sorts by id STRING (R10..R15 between R1 and R2)
     assert list(all_rules()) == ["R1", "R10", "R11", "R12", "R13", "R14",
-                                 "R2", "R3", "R4", "R5", "R6", "R7", "R8",
-                                 "R9"]
+                                 "R15", "R2", "R3", "R4", "R5", "R6",
+                                 "R7", "R8", "R9"]
 
 
 # -------------------------------------------------------------- suppressions
