@@ -202,6 +202,260 @@ def serve_smoke(argv) -> None:
                  f"(expected 0) — see {out_path}")
 
 
+def decode_smoke(argv) -> None:
+    """``--decode``: the generative-decoding gate (ROADMAP item 1).
+
+    A closed-loop storm of ``--decode_streams`` mixed-length prompts
+    through the continuous-batching decode engine
+    (``pdnlp_tpu.serve.decode``), gating the properties the KV cache
+    exists to buy:
+
+    - **tokens/s/chip >= 2x a no-cache re-prefill baseline** — the same
+      prompts generating the same token counts by re-running the bucketed
+      causal prefill per token (the cost of generation WITHOUT a cache,
+      batched just as wide, on the same engine programs);
+    - **zero post-warmup retraces** across the prefill buckets AND the
+      one fixed ``[slots, 1]`` decode shape;
+    - **inter-token p99 under ``--decode_p99_ms``** with continuous
+      batching holding **mean slot occupancy >= 0.8** under the mixed
+      stream mix;
+    - **chain integrity through a mid-storm replica kill**: a 2-replica
+      router storm, replica 0 killed once demonstrably mid-decode; every
+      stream's hop chain must validate through the trace-file round trip
+      AND every stream must emit EXACTLY the single-engine reference
+      token sequence (orphans re-prefill on the survivor — no duplicated,
+      no lost tokens).
+
+    Deterministic and CPU-safe (seeded prompts over a synthetic vocab,
+    greedy decode, EOS disabled so token counts are exact); snapshot at
+    ``results/decode_smoke.json``, non-zero exit on any violation.
+    """
+    import tempfile
+    import time
+
+    import jax
+    import numpy as np
+
+    from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
+    from pdnlp_tpu.obs.request import validate_chains
+    from pdnlp_tpu.serve import DecodeBatcher, DecodeEngine, DecodeRouter
+    from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
+
+    argv, n_streams = pop_cli_flag(argv, "--decode_streams", 48, int)
+    argv, slots = pop_cli_flag(argv, "--decode_slots_n", 8, int)
+    argv, max_new = pop_cli_flag(argv, "--decode_max_new", 24, int)
+    argv, p99_budget = pop_cli_flag(argv, "--decode_p99_ms", 500.0, float)
+    argv, out_path = pop_cli_flag(
+        argv, "--decode_out", os.path.join("results", "decode_smoke.json"))
+    trace_dir = tempfile.mkdtemp(prefix="decode_smoke_trace_")
+    args = parse_cli(argv, base=Args(
+        model="bert-tiny", decode_slots=slots, decode_max_len=96,
+        max_new_tokens=max_new, trace=True, trace_dir=trace_dir))
+    buckets = (16, 32, 64)
+
+    chars = "天地人你我他好坏大小上下来去爱恨喜怒哀乐高兴悲伤讨厌愤怒"
+    tok = WordPieceTokenizer(build_vocab([chars], size=256))
+    rng = np.random.default_rng(args.seed)
+    lens = rng.integers(3, 40, n_streams)
+    prompts = [rng.integers(5, tok.vocab_size, int(k)).tolist()
+               for k in lens]
+    failures = []
+
+    def make_engine():
+        return DecodeEngine(args, tokenizer=tok, mesh=None,
+                            buckets=buckets)
+
+    # ---------------------------------------------- phase A: cached decode
+    engine = make_engine()
+    batcher = DecodeBatcher(engine, max_waiting=n_streams).start()
+    batcher.eos_id = -1  # deterministic token counts
+    batcher.warmup()
+    retr0 = engine.metrics.retraces.value
+    miss0 = engine.metrics.cache_misses.value
+    t0 = time.monotonic()
+    streams = [batcher.submit_ids(p, max_new_tokens=max_new)
+               for p in prompts]
+    refs = [s.result(timeout=600) for s in streams]
+    decode_sec = time.monotonic() - t0
+    snap = batcher.snapshot()
+    batcher.stop()
+    tokens_out = snap["decode"]["tokens_out_total"]
+    retraces_post = engine.metrics.retraces.value - retr0
+    misses_post = engine.metrics.cache_misses.value - miss0
+    n_chips = jax.device_count()
+    decode_tps_chip = tokens_out / decode_sec / n_chips
+    occupancy_mean = snap["replica"]["slot_occupancy"]["mean"]
+    intertoken_p99 = snap["decode"]["intertoken_ms"]["p99"]
+
+    # ------------------------------------- phase B: no-cache re-prefill
+    # the same generations WITHOUT a KV cache: every token re-runs the
+    # bucketed causal prefill over prompt + generated-so-far, batched
+    # prefill_rows wide on the same engine programs (filler slot ids, so
+    # nothing touches the cache) — the honest cost of cacheless decoding
+    rows = engine.prefill_rows
+    t0 = time.monotonic()
+    base_tokens = 0
+    for i in range(0, n_streams, rows):
+        group = list(range(i, min(i + rows, n_streams)))
+        seqs = [list(prompts[g]) for g in group]
+        done = [False] * len(group)
+        while not all(done):
+            live = [j for j in range(len(group)) if not done[j]]
+            logits = engine.prefill_ids(
+                [seqs[j] for j in live],
+                [engine.slots] * len(live))  # OOB: cache untouched
+            for r, j in enumerate(live):
+                seqs[j].append(int(np.argmax(logits[r])))
+                base_tokens += 1
+                g = group[j]
+                if len(seqs[j]) - len(prompts[g]) >= len(refs[g]):
+                    done[j] = True
+    baseline_sec = time.monotonic() - t0
+    baseline_tps_chip = base_tokens / baseline_sec / n_chips
+    speedup = decode_tps_chip / baseline_tps_chip
+
+    # the baseline must reproduce the cached path's tokens — otherwise
+    # the speedup compares garbage.  One seeded stream re-verified here
+    # (the full bitwise contract is tier-1's test_decode job)
+    parity_ok = True
+    g0 = list(prompts[0])
+    for t in refs[0]:
+        lg = engine.prefill_ids([g0], [engine.slots])
+        if int(np.argmax(lg[0])) != t:
+            parity_ok = False
+            break
+        g0.append(t)
+
+    # ------------------------------------------- phase C: replica kill
+    engines = [make_engine() for _ in range(2)]
+    tracer = engines[0].tracer
+    for e in engines[1:]:
+        e.tracer = tracer
+    router = DecodeRouter(engines, max_waiting=n_streams).start()
+    for b in router.batchers:
+        b.eos_id = -1
+    router.warmup()
+    kill_retr0 = sum(e.metrics.retraces.value for e in engines)
+    kstreams = [router.submit_ids(p, max_new_tokens=max_new)
+                for p in prompts]
+    deadline = time.monotonic() + 120
+    while (router.batchers[0].metrics.tokens_out_total.value
+           < max_new * slots and time.monotonic() < deadline):
+        time.sleep(0.002)
+    router.kill(0)
+    kouts = [s.result(timeout=600) for s in kstreams]
+    kill_retraces = sum(e.metrics.retraces.value
+                        for e in engines) - kill_retr0
+    requeued_in = router.batchers[1].rmetrics.requeued_in.value
+    router.stop()
+    kill_parity = kouts == refs
+
+    # chain integrity through the FILE round trip: flush, re-read, check
+    trace_path = tracer.flush()
+    records = []
+    with open(trace_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    report = validate_chains(records, [s.rid for s in kstreams])
+
+    # ------------------------------------------------------------- gates
+    if speedup < 2.0:
+        failures.append(f"decode tokens/s/chip only {speedup:.2f}x the "
+                        "re-prefill baseline (gate: >= 2x)")
+    if retraces_post != 0 or misses_post != 0:
+        failures.append(f"{retraces_post} post-warmup retraces / "
+                        f"{misses_post} compile-cache misses (gate: 0)")
+    if kill_retraces != 0:
+        failures.append(f"{kill_retraces} retraces in the kill storm "
+                        "(gate: 0 — both replicas warmed)")
+    if intertoken_p99 is None or intertoken_p99 > p99_budget:
+        failures.append(f"inter-token p99 {intertoken_p99} ms over the "
+                        f"{p99_budget} ms budget")
+    if occupancy_mean is None or occupancy_mean < 0.8:
+        failures.append(f"mean slot occupancy {occupancy_mean} under the "
+                        "0.8 continuous-batching gate")
+    if not parity_ok:
+        failures.append("re-prefill baseline diverged from cached decode "
+                        "(argmax) — the speedup comparison is invalid")
+    if not kill_parity:
+        failures.append("mid-storm kill duplicated or lost tokens "
+                        "(continuations != single-engine reference)")
+    if report["incomplete"]:
+        failures.append(f"{len(report['incomplete'])} incomplete hop "
+                        "chains through the kill storm")
+    if report["requeued"] < 1 or report["re_prefilled"] < 1:
+        failures.append("the kill never exercised requeue/re-prefill — "
+                        "the chaos leg proved nothing")
+
+    result = {
+        "metric": "decode_smoke",
+        "streams": n_streams,
+        "slots": engine.slots,
+        "max_new_tokens": max_new,
+        "prompt_lens": [int(lens.min()), int(lens.max())],
+        "decode": {
+            "tokens_out": int(tokens_out),
+            "elapsed_sec": round(decode_sec, 3),
+            "tokens_per_sec_per_chip": round(decode_tps_chip, 1),
+            "intertoken_ms_p50": snap["decode"]["intertoken_ms"]["p50"],
+            "intertoken_ms_p99": intertoken_p99,
+            "ttft_ms_p50": snap["decode"]["ttft_ms"]["p50"],
+            "slot_occupancy_mean": occupancy_mean,
+            "slot_reuse_ms_p50": snap["replica"]["slot_reuse_ms"]["p50"],
+            "retraces_post_warmup": int(retraces_post),
+            "kv": snap["kv"],
+        },
+        "reprefill_baseline": {
+            "tokens_out": int(base_tokens),
+            "elapsed_sec": round(baseline_sec, 3),
+            "tokens_per_sec_per_chip": round(baseline_tps_chip, 1),
+            "argmax_parity_with_cached": bool(parity_ok),
+        },
+        "speedup_vs_reprefill": round(speedup, 2),
+        "kill_storm": {
+            "replicas": 2,
+            "token_parity_with_reference": bool(kill_parity),
+            "retraces": int(kill_retraces),
+            "requeued_to_survivor": int(requeued_in),
+            "chains_checked": report["checked"],
+            "chains_complete": report["complete"],
+            "chains_requeued": report["requeued"],
+            "chains_re_prefilled": report["re_prefilled"],
+        },
+        "p99_budget_ms": p99_budget,
+        "model": args.model,
+        "kv_dtype": engine.kv_snapshot()["kv_dtype"],
+        "devices": n_chips,
+        "platform": jax.devices()[0].platform,
+        "gates": {
+            "speedup_ge_2x": speedup >= 2.0,
+            "zero_post_warmup_retraces": retraces_post == 0
+            and misses_post == 0 and kill_retraces == 0,
+            "intertoken_p99_under_budget": bool(
+                intertoken_p99 is not None
+                and intertoken_p99 <= p99_budget),
+            "slot_occupancy_ge_0.8": bool(occupancy_mean is not None
+                                          and occupancy_mean >= 0.8),
+            "kill_chains_complete_no_dup_no_loss": bool(
+                kill_parity and not report["incomplete"]),
+        },
+        "failures": failures,
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, out_path)
+    print(json.dumps({k: v for k, v in result.items()
+                      if k not in ("decode", "reprefill_baseline")}))
+    if failures:
+        sys.exit("decode smoke FAILED:\n  - " + "\n  - ".join(failures)
+                 + f"\n  see {out_path}")
+
+
 def serve_load_smoke(argv) -> None:
     """``--serve-load``: closed-loop SLO gate for the multi-replica router.
 
@@ -3698,6 +3952,12 @@ def main() -> None:
         # --serve-load
         argv.remove("--replay")
         return replay_smoke(argv)
+    if "--decode" in argv:
+        # generative-decoding gate (sharded KV cache, prefill/decode
+        # split, continuous batching, mid-storm kill —
+        # results/decode_smoke.json); an intercept like --serve-load
+        argv.remove("--decode")
+        return decode_smoke(argv)
     if "--serve-load" in argv or "--serve_load" in argv:
         # closed-loop router SLO gate (results/serve_load_smoke.json):
         # Poisson storm + mid-storm replica kill + rolling swap + overload

@@ -19,4 +19,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r13_unrecorded_actuation,
     r14_quadratic_bias,
     r15_unrecorded_traffic_shift,
+    r16_kv_realloc,
 )

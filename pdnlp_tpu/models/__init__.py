@@ -5,5 +5,7 @@
 """
 from pdnlp_tpu.models.config import BertConfig, available_models, get_config
 from pdnlp_tpu.models import bert
+from pdnlp_tpu.models import decoder
 
-__all__ = ["BertConfig", "available_models", "get_config", "bert"]
+__all__ = ["BertConfig", "available_models", "get_config", "bert",
+           "decoder"]

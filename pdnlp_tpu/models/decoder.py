@@ -1,0 +1,320 @@
+"""Causal decoder head over the BERT trunk + the KV-cache decode math.
+
+The serving tier was classification-shaped: one forward, one logit row per
+request.  Generative decoding inverts the cost structure — autoregressive
+decode is memory-bandwidth-bound, so tokens/s is won on *not recomputing*
+the prompt every token.  This module is the pure-math half of that story
+(the serving half — slots, continuous batching, budgets — lives in
+``pdnlp_tpu.serve.decode``):
+
+- **one trunk, three programs**: the decoder reuses the classifier's param
+  tree (``bert.init_params`` — embeddings, stacked layers) under an LM
+  head shaped exactly like the MLM head (``init_lm_head`` — transform +
+  LayerNorm + decoder TIED to the word embeddings), so any strategy
+  checkpoint serves generatively without conversion.  :func:`prefill`
+  runs the prompt causally and RETURNS the per-layer K/V it computed;
+  :func:`decode_step` advances one token against a slot-indexed cache;
+  :func:`infill_logits` is the bidirectional MLM-infilling scorer (same
+  trunk, no causal mask — BERT's native objective served online).
+- **KV cache layout** ``[L, slots, max_len, N, D]``: layer-major so the
+  layer scan streams one ``[slots, max_len, N, D]`` slab per step;
+  ``max_len`` ahead of heads so cached keys keep the trunk's ``[B, S, N,
+  D]`` attention layout — cached and recomputed attention then share ONE
+  einsum/reduction shape, which is what makes the bitwise decode-parity
+  contract below provable instead of approximate.
+- **the bitwise contract**: incremental decode over a live cache is
+  bitwise equal, per step, to a FULL RECOMPUTE from a cold cache — a
+  fresh prefill of the prompt plus a from-scratch replay of every
+  generated token, nothing reused (``tests/test_decode.py`` pins it; the
+  bench gates it mid-storm).  The contract is provable because every
+  decode shape is FIXED (``[rows, 1]`` tokens, ``[rows]`` positions, the
+  preallocated cache), so both sides run identical programs on
+  bitwise-equal inputs, and the -1e9 additive masks zero invisible keys'
+  probabilities EXACTLY (masked cache rows contribute exact ``+0.0``
+  regardless of their stale contents).  Against the one-shot WIDE causal
+  forward the comparison is argmax-exact within ~1e-6 instead: XLA's CPU
+  gemm blocks the contraction differently per row extent (measured:
+  ``[3, 512] @ [512, 128]`` vs the same rows at extent 96 differ by
+  ULPs), so a ``[rows, 1]`` pass and a ``[rows, S]`` pass are only
+  accumulation-order-equal, not bit-equal, on that backend.
+- **int8 KV** (:func:`quantize_kv` / :func:`dequantize_kv`): the cache
+  stores int8 against per-(layer, head, channel) symmetric scale tables —
+  the PR-6 per-channel machinery pointed at activations.  Scales are
+  CALIBRATED (:func:`calibrate_kv_scales` — a seeded synthetic forward,
+  identical math offline in ``scripts/quantize_ckpt.py --kv_calib`` and
+  online at engine warmup, so the two routes can never disagree); new K/V
+  quantize on write, the whole cache dequantizes by one broadcast
+  multiply on read, and no fp32 copy of the cache ever persists.
+
+The hot decode shapes are fixed by construction — ``[rows, 1]`` tokens,
+``[rows]`` positions, the preallocated cache — so a jitted
+:func:`decode_step` can never retrace after its first trace (the serve
+engine donates the cache buffers across steps; jaxlint R16 polices the
+rebuild-the-cache anti-pattern).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pdnlp_tpu.models import bert
+from pdnlp_tpu.models.config import BertConfig
+from pdnlp_tpu.ops.attention import dot_product_attention, mask_bias
+
+Params = Dict[str, Any]
+
+#: seeded synthetic calibration batch (shared by the offline artifact and
+#: engine self-calibration — identical inputs => identical scale tables)
+CALIB_SEED = 20240801
+CALIB_ROWS = 4
+
+
+def init_lm_head(key: jax.Array, cfg: BertConfig) -> Params:
+    """LM head params — the MLM head's exact tree (transform + LayerNorm +
+    per-token bias; decoder tied to the word embeddings), kept as a
+    SEPARATE tree so classifier checkpoints load into the trunk unchanged.
+    One init for both roles: MLM infilling and causal next-token share the
+    head, which is what lets a single checkpoint serve both scorers."""
+    return bert.init_mlm_head(key, cfg)
+
+
+def lm_logits(params: Params, head: Params, cfg: BertConfig,
+              hidden: jax.Array, *, dtype=jnp.float32) -> jax.Array:
+    """[B, S, H] -> [B, S, vocab] fp32 (tied decoder — ``bert.mlm_logits``)."""
+    return bert.mlm_logits(params, head, cfg, hidden, dtype=dtype)
+
+
+# --------------------------------------------------------------- attention
+
+def _qkv(x: jax.Array, lp: Params, cfg: BertConfig, dtype):
+    B, S = x.shape[0], x.shape[1]
+    N, D = cfg.num_heads, cfg.head_dim
+
+    def heads(t):
+        return t.reshape(B, S, N, D)
+
+    return (heads(bert._dense(x, lp["q"], dtype)),
+            heads(bert._dense(x, lp["k"], dtype)),
+            heads(bert._dense(x, lp["v"], dtype)))
+
+
+def _finish_layer(x, lp, cfg, attn, dtype):
+    """Post-attention half of one trunk layer (deterministic serve form):
+    output projection + residual LN + MLP + residual LN — ``bert``'s exact
+    ops, so decoder hidden states match the trunk bit for bit."""
+    B, S = x.shape[0], x.shape[1]
+    attn = bert._dense(attn.reshape(B, S, -1), lp["o"], dtype)
+    x = bert._layer_norm(x + attn, lp["attn_ln"]["scale"],
+                         lp["attn_ln"]["bias"], cfg.layer_norm_eps)
+    h = bert._gelu(bert._dense(x, lp["up"], dtype), cfg.gelu)
+    h = bert._dense(h, lp["down"], dtype)
+    return bert._layer_norm(x + h, lp["mlp_ln"]["scale"],
+                            lp["mlp_ln"]["bias"], cfg.layer_norm_eps)
+
+
+def _check_dense_trunk(layers: Params) -> None:
+    if "gate" in layers:
+        raise ValueError(
+            "generative decoding over an MoE trunk is not supported — the "
+            "expert dispatch has no cached single-token form yet; serve a "
+            "dense checkpoint (--model without -moe)")
+
+
+# ----------------------------------------------------------------- prefill
+
+def run_layers_kv(layers: Params, cfg: BertConfig, x: jax.Array, *,
+                  bias: jax.Array, causal: bool = True,
+                  dtype=jnp.float32, unroll=True
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Causal layer scan that also RETURNS what it computed: hidden
+    [B, S, H] plus per-layer K/V stacked ``[L, B, S, N, D]`` — the arrays
+    the serve engine scatters into its slot cache, at zero extra compute
+    (prefill had to build them anyway; the classifier path just threw
+    them away).  Attention rides ``ops.attention`` (the causal
+    composition and its routing live there, not here)."""
+    _check_dense_trunk(layers)
+
+    def layer(carry, scanned):
+        x = carry
+        lp, _ = scanned
+        q, k, v = _qkv(x, lp, cfg, dtype)
+        # "auto" routes causal/decode shapes to XLA everywhere today
+        # (routed_impl: the flash kernel has no causal term) while leaving
+        # the decision at the ops routing point, not pinned here
+        attn = dot_product_attention(q, k, v, bias, impl="auto",
+                                     causal=causal)
+        return _finish_layer(x, lp, cfg, attn, dtype), (k, v)
+
+    li = jnp.arange(cfg.num_layers)
+    x, (ks, vs) = jax.lax.scan(layer, x, (layers, li), unroll=unroll)
+    return x, ks, vs
+
+
+def prefill(params: Params, head: Params, cfg: BertConfig,
+            input_ids: jax.Array,       # [B, S] int32 (left-aligned)
+            attention_mask: jax.Array,  # [B, S] {0,1}
+            last_pos: jax.Array,        # [B] int32: index of last real token
+            *, dtype=jnp.float32, unroll=True
+            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Causal prompt forward: next-token logits [B, vocab] (fp32, read at
+    each row's ``last_pos``) + the per-layer K/V ``[L, B, S, N, D]``.
+
+    The mask is causal ∘ key-padding (``ops.attention.causal_bias`` — the
+    sanctioned quadratic site, composed inside ``dot_product_attention``):
+    with left-aligned prompts the causal term already hides padding from
+    every real row, and the explicit padding term keeps the composition
+    correct for any caller that right-pads."""
+    zeros = jnp.zeros_like(input_ids)
+    x, _ = bert.embed(params, cfg, input_ids, zeros, dtype=dtype,
+                      deterministic=True)
+    bias = mask_bias(attention_mask, jnp.float32)
+    hidden, ks, vs = run_layers_kv(params["layers"], cfg, x, bias=bias,
+                                   causal=True, dtype=dtype, unroll=unroll)
+    h_last = jnp.take_along_axis(
+        hidden, last_pos.astype(jnp.int32)[:, None, None], axis=1)  # [B,1,H]
+    logits = lm_logits(params, head, cfg, h_last, dtype=dtype)[:, 0]
+    return logits, ks, vs
+
+
+# ------------------------------------------------------------------ decode
+
+def quantize_kv(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """fp K/V rows -> int8 against per-(head, channel) scales (broadcast
+    over leading dims) — the PR-6 symmetric per-channel rule on
+    activations."""
+    q = jnp.round(x.astype(jnp.float32) / scale)
+    return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """int8 cache slab -> compute dtype by one broadcast multiply (no fp32
+    copy persists — the multiply fuses into the attention reads)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def decode_step(params: Params, head: Params, cfg: BertConfig,
+                tokens: jax.Array,   # [B, 1] int32: the CURRENT token
+                cache_k: jax.Array,  # [L, B, max_len, N, D] (fp or int8)
+                cache_v: jax.Array,
+                pos: jax.Array,      # [B] int32: write position of `tokens`
+                *, kv_scales: Optional[Tuple[jax.Array, jax.Array]] = None,
+                dtype=jnp.float32, unroll=True
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One fixed-shape decode step: embed ``tokens`` at ``pos``, write
+    their K/V into the cache at ``pos`` (``.at[].set`` — an in-place
+    dynamic update on a donated buffer, never a rebuild), attend over
+    positions ``<= pos``, return (next-token logits [B, vocab] fp32,
+    cache_k', cache_v').
+
+    Every shape here is static — [B, 1] tokens, [B] positions, the
+    preallocated cache — so the jitted form holds exactly ONE compiled
+    program (retrace-free by the same construction as ``infer_packed``).
+    ``kv_scales`` = (k_scale, v_scale) ``[L, N, D]`` switches the cache to
+    int8: new rows quantize before the write, slabs dequantize per layer
+    at read.  The CURRENT token's K/V round-trips through the cache too —
+    the step attends to what FUTURE steps will see, so int8 error is
+    consistent across the stream instead of hidden on the diagonal."""
+    _check_dense_trunk(params["layers"])
+    B = tokens.shape[0]
+    max_len = cache_k.shape[2]
+    pos = pos.astype(jnp.int32)
+    x, _ = bert.embed(params, cfg, tokens, jnp.zeros_like(tokens),
+                      dtype=dtype, deterministic=True,
+                      position_ids=pos[:, None])
+    # linear visibility mask: key j visible iff j <= pos (prompt + already
+    # decoded + the token just written); never a [S, S] term
+    visible = (jnp.arange(max_len)[None, :] <= pos[:, None])
+    bias = mask_bias(visible.astype(jnp.float32), jnp.float32)
+    rows = jnp.arange(B)
+
+    def layer(carry, scanned):
+        x = carry
+        if kv_scales is None:
+            lp, _, ck, cv = scanned
+        else:
+            lp, _, ck, cv, ks_l, vs_l = scanned
+        q, k_new, v_new = _qkv(x, lp, cfg, dtype)         # [B, 1, N, D]
+        if kv_scales is None:
+            ck = ck.at[rows, pos].set(k_new[:, 0])
+            cv = cv.at[rows, pos].set(v_new[:, 0])
+            kf, vf = ck, cv
+        else:
+            ck = ck.at[rows, pos].set(quantize_kv(k_new[:, 0], ks_l))
+            cv = cv.at[rows, pos].set(quantize_kv(v_new[:, 0], vs_l))
+            kf = dequantize_kv(ck, ks_l, dtype)
+            vf = dequantize_kv(cv, vs_l, dtype)
+        attn = dot_product_attention(q, kf, vf, bias, impl="auto")
+        return _finish_layer(x, lp, cfg, attn, dtype), (ck, cv)
+
+    li = jnp.arange(cfg.num_layers)
+    xs = (params["layers"], li, cache_k, cache_v)
+    if kv_scales is not None:
+        xs = xs + (kv_scales[0], kv_scales[1])
+    x, (cache_k, cache_v) = jax.lax.scan(layer, x, xs, unroll=unroll)
+    logits = lm_logits(params, head, cfg, x, dtype=dtype)[:, 0]
+    return logits, cache_k, cache_v
+
+
+# ------------------------------------------------------- infilling scoring
+
+def infill_logits(params: Params, head: Params, cfg: BertConfig,
+                  input_ids: jax.Array,       # [B, S] int32
+                  attention_mask: jax.Array,  # [B, S] {0,1}
+                  *, dtype=jnp.float32, attn_impl: str = "auto",
+                  unroll=True) -> jax.Array:
+    """MLM-infilling scorer: the BIDIRECTIONAL trunk (BERT's native
+    objective — no causal mask) + the LM head over every position,
+    [B, S, vocab] fp32.  The serve engine reads the rows at ``[MASK]``
+    positions; everything (trunk, head, tied decoder) is shared with the
+    causal path, so one checkpoint answers both "continue this" and
+    "fill this in"."""
+    zeros = jnp.zeros_like(input_ids)
+    hidden = bert.encode(params, cfg, input_ids, zeros, attention_mask,
+                         dtype=dtype, deterministic=True,
+                         attn_impl=attn_impl, unroll=unroll)
+    return lm_logits(params, head, cfg, hidden, dtype=dtype)
+
+
+# ------------------------------------------------------------- calibration
+
+def kv_cache_bytes(cfg: BertConfig, slots: int, max_len: int,
+                   kv_dtype) -> int:
+    """Preallocated K+V cache bytes for a slot block — the number the
+    ``--kv_hbm_mb`` budget (obs.memory.KVBudget) is checked against."""
+    itemsize = np.dtype(kv_dtype).itemsize
+    return int(2 * cfg.num_layers * slots * max_len * cfg.hidden_size
+               * itemsize)
+
+
+def calibrate_kv_scales(params: Params, cfg: BertConfig, *,
+                        seq_len: Optional[int] = None,
+                        dtype=jnp.float32
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-(layer, head, channel) symmetric int8 K/V scale tables
+    ``[L, N, D]`` from a SEEDED synthetic causal forward — no corpus, no
+    device requirement, and deterministic in the params alone, so the
+    offline artifact (``scripts/quantize_ckpt.py --kv_calib``) and engine
+    self-calibration at warmup produce byte-identical tables."""
+    seq_len = int(seq_len or min(128, cfg.max_position))
+    # a raw host tree (the offline script's load_raw) must compute through
+    # the SAME backend as device params — numpy operands would dispatch
+    # numpy's BLAS on the first matmul and the tables would drift by ULPs
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    key = jax.random.key(CALIB_SEED)
+    ids = jax.random.randint(key, (CALIB_ROWS, seq_len), 0, cfg.vocab_size,
+                             dtype=jnp.int32)
+    mask = jnp.ones((CALIB_ROWS, seq_len), jnp.int32)
+    x, _ = bert.embed(params, cfg, ids, jnp.zeros_like(ids), dtype=dtype,
+                      deterministic=True)
+    _, ks, vs = run_layers_kv(params["layers"], cfg, x,
+                              bias=mask_bias(mask, jnp.float32),
+                              causal=True, dtype=dtype)
+    # amax over (rows, positions) -> [L, N, D]; zero channels get scale 1
+    def table(t):
+        amax = np.abs(np.asarray(t, np.float32)).max(axis=(1, 2))
+        return np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+
+    return table(ks), table(vs)

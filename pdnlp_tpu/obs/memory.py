@@ -94,6 +94,90 @@ def memory_snapshot(devices: Optional[Sequence] = None) -> Dict:
     }
 
 
+class KVBudgetExceeded(RuntimeError):
+    """A generative stream (or a decode engine's cache preallocation)
+    would exceed the declared ``--kv_hbm_mb`` KV budget — the LOUD
+    refusal that replaces an allocator OOM three layers deeper."""
+
+
+class KVBudget:
+    """Declared KV-cache HBM budget for one decode engine.
+
+    The decode engine preallocates its slot cache ONCE (``[L, slots,
+    max_len, N, D]`` ×2, donated across steps — decode never allocates),
+    so the budget decision happens at two doors, both loud:
+
+    - **construction**: :meth:`cap_slots` returns how many slots the
+      declared budget actually covers — the engine allocates THAT many
+      (stderr-noted when capped below the request) and refuses outright
+      (:class:`KVBudgetExceeded`) when not even one slot fits;
+    - **admission**: :meth:`check_stream` refuses a stream whose
+      worst-case footprint (``prompt + max_new_tokens`` positions) cannot
+      fit a slot under the budget — the caller gets the budget math, not
+      a mid-decode OOM.
+
+    Live occupancy (:meth:`set_live` / :attr:`live_bytes`) is the
+    ``/metrics`` gauge: positions actually WRITTEN across live slots ×
+    bytes per position — what the cache holds now, not the preallocation.
+    ``budget_bytes=None`` (no ``--kv_hbm_mb``) disables every check and
+    keeps only the gauge."""
+
+    def __init__(self, budget_mb: Optional[float] = None):
+        self.budget_bytes: Optional[int] = (
+            None if not budget_mb else int(float(budget_mb) * 2**20))
+        self._live = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- doors
+    def cap_slots(self, requested: int, slot_bytes: int) -> int:
+        """Slots the budget covers (= ``requested`` when unbudgeted);
+        raises :class:`KVBudgetExceeded` when it cannot cover one."""
+        if self.budget_bytes is None:
+            return int(requested)
+        fit = self.budget_bytes // max(1, int(slot_bytes))
+        if fit < 1:
+            raise KVBudgetExceeded(
+                f"kv_hbm_mb={self.budget_bytes / 2**20:.1f} cannot hold "
+                f"even one decode slot ({slot_bytes / 2**20:.1f} MB of KV "
+                "at this max_len/model) — raise --kv_hbm_mb or shrink "
+                "--decode_max_len")
+        return min(int(requested), int(fit))
+
+    def check_stream(self, tokens_total: int, token_bytes: int) -> None:
+        """Admission door: refuse a stream whose worst-case KV cannot fit
+        under the budget (prompt + max_new positions × bytes/position)."""
+        if self.budget_bytes is None:
+            return
+        need = int(tokens_total) * int(token_bytes)
+        if need > self.budget_bytes:
+            raise KVBudgetExceeded(
+                f"stream needs {need / 2**20:.1f} MB of KV "
+                f"({tokens_total} positions) but the declared budget is "
+                f"{self.budget_bytes / 2**20:.1f} MB (--kv_hbm_mb) — "
+                "shorten the prompt / max_new_tokens or raise the budget")
+
+    # ------------------------------------------------------------- gauge
+    def set_live(self, nbytes: int) -> None:
+        with self._lock:
+            self._live = int(nbytes)
+
+    @property
+    def live_bytes(self) -> int:
+        with self._lock:
+            return self._live
+
+    def snapshot(self) -> Dict:
+        """JSON-ready block for engine snapshots / the live exporter."""
+        with self._lock:
+            live = self._live
+        return {
+            "budget_mb": (None if self.budget_bytes is None
+                          else round(self.budget_bytes / 2**20, 3)),
+            "live_bytes": live,
+            "live_mb": round(live / 2**20, 3),
+        }
+
+
 class MemorySampler:
     """Phase-boundary HBM sampler (module docstring).
 

@@ -61,10 +61,14 @@ _ADOPTION_ATTRS = ("attn_impl", "dtype")
 #: ``compile`` (engine execution, cache hit vs first-seen shape; packed
 #: forwards additionally carry ``packed``/``fill``/``segments`` attrs —
 #: token-level fill and riding-request count per batch), ``swap`` (a
-#: rolling checkpoint hot-swap).  Spans carrying a ``replica`` attr feed
-#: the PER-REPLICA phase tables — one sick replica must show up as itself
-#: in ``trace_tpu.py summarize``, not as a pool-average smear.
-SERVE_PHASES = ("queue_wait", "forward", "compile", "swap")
+#: rolling checkpoint hot-swap).  Generative decoding adds ``prefill``
+#: (bucketed causal prompt forward + KV insert, ``streams``/``tokens``
+#: attrs) and ``decode`` (ONE fixed-shape step over the slot block,
+#: ``live`` attr = rows actually advancing).  Spans carrying a ``replica``
+#: attr feed the PER-REPLICA phase tables — one sick replica must show up
+#: as itself in ``trace_tpu.py summarize``, not as a pool-average smear.
+SERVE_PHASES = ("queue_wait", "forward", "compile", "swap", "prefill",
+                "decode")
 
 
 def _bucket_key(bucket) -> tuple:

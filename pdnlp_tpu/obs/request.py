@@ -63,6 +63,21 @@ hop                   meaning / extra attrs
                       drained back to the primary (``from_model``,
                       ``to_model``) — non-terminal; the request still gets
                       exactly one terminal, on the primary
+``prefill``           generative stream: the prompt's causal forward ran
+                      and its K/V landed in a claimed cache slot
+                      (``slot``, ``tokens_in``, ``replica``).  Appears
+                      again after a ``requeue`` — an orphaned stream
+                      re-prefills ``prompt + emitted`` on a survivor
+``decode``            generative stream: one fixed-shape decode step
+                      advanced this stream (``slot``; ``step`` — the
+                      index of the token this step produces: token 0
+                      comes from prefill, so decode hops carry 1..;
+                      ``tokens_out`` — cumulative tokens emitted
+                      including this step's).  A streaming
+                      chain is ``admit → prefill → decode* → complete``
+                      (``decode*`` may be empty: a stream whose first
+                      token is EOS or whose budget is 1 completes
+                      straight from prefill)
 ``complete``          logits delivered (terminal; ``replica``; a shadow
                       duplicate's carries ``shadow=True``)
 ``deadline``          expired before execution (terminal)
@@ -167,7 +182,15 @@ def chain_issues(chain: Sequence[Dict]) -> List[str]:
       ``shadow=True`` — a shadow chain with a caller-visible terminal
       means a candidate answer could have leaked to a caller;
     - ``rollback`` is non-terminal: a rolled-back canary request still
-      gets exactly one terminal (on the primary it was drained back to).
+      gets exactly one terminal (on the primary it was drained back to);
+    - a STREAMING chain (``prefill``/``decode`` hops — generative
+      serving) must prefill before it decodes: every ``decode`` hop needs
+      an earlier ``prefill``, and a chain with a ``prefill`` must have
+      admitted first.  ``admit → prefill → decode* → complete`` is the
+      happy path; a mid-decode replica kill inserts ``requeue`` followed
+      by a SECOND ``prefill`` on the survivor (the continuation re-runs
+      ``prompt + emitted``), which is legal — what is not legal is
+      decoding from a cache no prefill filled.
 
     Deliberately NO timestamp-order check here:
     :func:`hop_chain`/:func:`chains` hand over chains already sorted by
@@ -197,6 +220,12 @@ def chain_issues(chain: Sequence[Dict]) -> List[str]:
         if any(h == "degrade" for h in hops[first_dispatch + 1:]):
             issues.append("'degrade' hop recorded after a dispatch — a "
                           "degrade decision must precede execution")
+    if "decode" in hops:
+        first_decode = hops.index("decode")
+        if "prefill" not in hops[:first_decode]:
+            issues.append("'decode' hop with no earlier 'prefill' — the "
+                          "stream decoded from a cache slot no prefill "
+                          "filled")
     terminals = [h for h in hops if h in TERMINAL_HOPS]
     if len(terminals) == 0:
         issues.append("no terminal hop (orphaned request)")
@@ -239,7 +268,8 @@ def validate_chains(records: Sequence[Dict],
         else sorted(by_id)
     report = {"checked": len(ids), "complete": 0, "incomplete": {},
               "requeued": 0, "repacked": 0, "hedged": 0,
-              "shadowed": 0, "degraded": 0, "rolled_back": 0}
+              "shadowed": 0, "degraded": 0, "rolled_back": 0,
+              "streamed": 0, "re_prefilled": 0}
     for rid in ids:
         chain = by_id.get(rid, [])
         issues = chain_issues(chain)
@@ -261,6 +291,11 @@ def validate_chains(records: Sequence[Dict],
             report["degraded"] += 1
         if any(h.get("hop") == "rollback" for h in hops):
             report["rolled_back"] += 1
+        prefills = sum(1 for h in hops if h.get("hop") == "prefill")
+        if prefills:
+            report["streamed"] += 1
+        if prefills > 1:  # a requeued stream re-prefilled on a survivor
+            report["re_prefilled"] += 1
     return report
 
 

@@ -62,6 +62,22 @@ def mask_bias(attention_mask: jax.Array, dtype=jnp.float32) -> jax.Array:
     ]
 
 
+def causal_bias(seq_len: int, dtype=jnp.float32) -> jax.Array:
+    """[1, 1, S, S] additive causal bias (row i attends j <= i).
+
+    This module is the SANCTIONED quadratic-mask site (jaxlint R14): the
+    generative decoder's bucketed prefill composes this with the key-padding
+    bias per forward, and the [S, S] term is a trace-time constant XLA
+    folds — callers must route through here rather than build their own
+    outer-product masks in hot paths.  The per-step decode path never needs
+    it: a ``[rows, 1]`` query masks with the LINEAR visibility bias
+    (``mask_bias`` over "position <= current"), which is what keeps decode
+    free of quadratic work entirely."""
+    i = jnp.arange(seq_len)
+    keep = i[:, None] >= i[None, :]
+    return jnp.where(keep, 0.0, NEG_INF).astype(dtype)[None, None]
+
+
 def resolve_impl(requested: str, *, segmented: bool = False,
                  backend: Optional[str] = None) -> str:
     """Backend-level routing: ``"xla"``/``"pallas"`` pass through;
@@ -81,7 +97,8 @@ def resolve_impl(requested: str, *, segmented: bool = False,
 
 
 def routed_impl(requested: str, seq_len: int, *, segmented: bool = False,
-                dropout: bool = False, backend: Optional[str] = None) -> str:
+                dropout: bool = False, causal: bool = False,
+                backend: Optional[str] = None) -> str:
     """The impl that will actually execute for this (static) configuration
     — the single decision :func:`dot_product_attention`, the trainer's
     ``step_dispatch`` span attr, and the bench JSON all share, so the
@@ -114,6 +131,17 @@ def routed_impl(requested: str, seq_len: int, *, segmented: bool = False,
         return "xla"
     if dropout:
         return "xla"  # kernel has no probability dropout (documented)
+    if causal:
+        # the flash kernel computes packed SEGMENT masks in-kernel but has
+        # no causal tile term yet; causal attention (the generative
+        # decoder's bucketed prefill) routes to XLA with the standard
+        # once-per-shape warning so a future kernel causal variant shows
+        # up as a routing change, not a silent drift.  The per-step decode
+        # path ([rows, 1] queries) could never tile the kernel anyway.
+        _warn_fallback(requested, seq_len,
+                       "kernel has no causal mask term (generative prefill "
+                       "runs XLA attention)")
+        return "xla"
     from pdnlp_tpu.ops import flash
 
     if not flash.supported_seq(seq_len):
@@ -127,14 +155,14 @@ def routed_impl(requested: str, seq_len: int, *, segmented: bool = False,
 @functools.lru_cache(maxsize=None)
 def routed_impl_cached(requested: str, seq_len: int, *,
                        segmented: bool = False,
-                       dropout: bool = False) -> str:
+                       dropout: bool = False, causal: bool = False) -> str:
     """Memoized :func:`routed_impl` for per-dispatch host-loop callers
     (the trainer's and the serve engine's span stamping): routing is pure
     in its hashable arguments, so the hot loop pays one dict hit — the
     memoization lives HERE, next to the decision it wraps, not re-rolled
     per caller.  The fallback warning stays once-per-process either way."""
     return routed_impl(requested, seq_len, segmented=segmented,
-                       dropout=dropout)
+                       dropout=dropout, causal=causal)
 
 
 def _warn_fallback(requested: str, seq_len: int, reason: str) -> None:
@@ -161,6 +189,7 @@ def dot_product_attention(
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,  # [B, S] int, 0 = padding
+    causal: bool = False,
 ) -> jax.Array:
     """Returns [B, S, N, D] attention output in q's dtype.
 
@@ -175,6 +204,14 @@ def dot_product_attention(
     materializes; the XLA path builds it here (the retained reference
     fallback — ``data.packing.segment_bias``, hoisted by CSE under the
     default fully-unrolled layer scan).
+
+    ``causal=True`` additionally masks row i from keys j > i
+    (:func:`causal_bias`) — the generative decoder's prefill contract.  It
+    COMPOSES with either a mask bias or ``segment_ids`` (a packed causal
+    row: examples stay block-diagonal AND left-to-right within their
+    segment), requires ``Sq == Sk`` (the per-step decode path carries its
+    own linear visibility bias instead), and always routes XLA (the kernel
+    has no causal term — :func:`routed_impl`).
     """
     if bias is not None and segment_ids is not None:
         # reject on EVERY route (the pallas kernel would raise; the XLA
@@ -183,9 +220,14 @@ def dot_product_attention(
         raise ValueError("pass bias OR segment_ids, not both — the packed "
                          "block-diagonal mask rides the IDs, and padding "
                          "is segment 0")
+    if causal and q.shape[1] != k.shape[1]:
+        raise ValueError(
+            "causal=True needs Sq == Sk (a square trace-time mask); a "
+            "decode-step query over a longer KV cache masks with its own "
+            "linear visibility bias (mask_bias of 'position <= current')")
     use_dropout = dropout_rate > 0.0 and dropout_rng is not None
     impl = routed_impl(impl, q.shape[1], segmented=segment_ids is not None,
-                       dropout=use_dropout)
+                       dropout=use_dropout, causal=causal)
     if impl == "pallas":
         from pdnlp_tpu.ops import flash
 
@@ -194,6 +236,9 @@ def dot_product_attention(
         from pdnlp_tpu.data.packing import segment_bias
 
         bias = segment_bias(segment_ids, dtype=jnp.float32).astype(q.dtype)
+    if causal:
+        cb = causal_bias(q.shape[1], jnp.float32)
+        bias = cb if bias is None else bias.astype(jnp.float32) + cb
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqnd,bknd->bnqk", q, k) * scale
     if bias is not None:

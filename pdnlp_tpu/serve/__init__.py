@@ -29,7 +29,13 @@ requests) — this package applies the same treatment to inference:
 - :mod:`pdnlp_tpu.serve.replay` — trace-driven load replay: recorded
   request-hop chains reconstructed into arrival schedules, reshaped
   (steady / diurnal ramp / flash crowd) and re-driven at 1x/5x/20x speed
-  (``bench.py --replay``).
+  (``bench.py --replay``);
+- :mod:`pdnlp_tpu.serve.decode` — generative decoding: a slot-indexed
+  donated KV cache (optionally int8 against calibrated per-channel scale
+  tables), bucketed prefill / one fixed-shape decode step, continuous
+  batching with streaming responses, a declared KV HBM budget
+  (``--kv_hbm_mb``), and a decode replica router whose kill-recovery
+  re-prefills orphan streams on survivors (``serve_tpu.py --decode``).
 
 Entry point: ``serve_tpu.py`` at the repo root.
 """
@@ -38,12 +44,16 @@ from pdnlp_tpu.serve.batcher import (  # noqa: F401
     LoadShedError, QueueFullError, pick_bucket, resolve_serve_pack,
 )
 from pdnlp_tpu.serve.controller import KnobSpec, ServeController  # noqa: F401
+from pdnlp_tpu.serve.decode import (  # noqa: F401
+    DecodeBatcher, DecodeEngine, DecodeRouter, DecodeStream,
+)
 from pdnlp_tpu.serve.engine import InferenceEngine  # noqa: F401
 from pdnlp_tpu.serve.fleet import (  # noqa: F401
     FleetRouter, ModelSpec, RolloutPlan, ShadowReport, parse_fleet_spec,
 )
 from pdnlp_tpu.serve.metrics import (  # noqa: F401
-    FleetMetrics, ReplicaMetrics, RouterMetrics, ServeMetrics,
+    DecodeMetrics, FleetMetrics, ReplicaMetrics, RouterMetrics,
+    ServeMetrics,
 )
 from pdnlp_tpu.serve.offline import score_texts  # noqa: F401
 from pdnlp_tpu.serve.router import (  # noqa: F401
@@ -54,6 +64,11 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "AdmissionControl",
     "DeadlineExceeded",
+    "DecodeBatcher",
+    "DecodeEngine",
+    "DecodeMetrics",
+    "DecodeRouter",
+    "DecodeStream",
     "DynamicBatcher",
     "FleetMetrics",
     "FleetRouter",

@@ -1,0 +1,1046 @@
+"""Generative decode engine: sharded slot KV cache, prefill/decode split,
+continuous batching.
+
+The serving tier built since PR 6 scales a SCORER — one forward, one logit
+row.  This module turns it into a text service, built on the observation
+that autoregressive decode is memory-bandwidth-bound: tokens/s/chip is won
+or lost on (a) never recomputing the prompt (the KV cache), (b) never
+retracing (fixed shapes, donated buffers), and (c) never running the
+decode batch partially empty (continuous batching).
+
+- **slot-indexed KV cache**: one preallocated pair of ``[L, slots,
+  max_len, N, D]`` buffers per engine (``models.decoder`` layout note),
+  DONATED across steps — steady-state decode allocates nothing.  A slot
+  is the unit of admission: a stream claims one at prefill, writes
+  forward as it decodes, and frees it between steps when it finishes —
+  slot reuse is ``form_packed_batch``'s row-reuse idea made stateful.
+  On a mesh the slot axis shards over ``data`` like every serve batch.
+- **prefill/decode split**: prompts execute as bucketed ``[prefill_rows,
+  bucket]`` causal forwards riding the same compile-cache discipline as
+  the classifier engine (one trace per bucket, warmup pre-traces all);
+  their K/V scatter into claimed slots (``.at[slots].set`` with
+  out-of-bounds filler rows DROPPED — filler never touches a live slot).
+  Decode is ONE ``[slots, 1]`` program — retrace-free by the same
+  construction as ``infer_packed``: after :meth:`DecodeEngine.warmup`
+  there is exactly one compiled decode step and nothing live traffic
+  does can create another.
+- **continuous batching** (:class:`DecodeBatcher`): between decode steps,
+  finished streams leave and waiting streams claim freed slots (prefill
+  rides the same worker, so the decode batch is re-filled before the
+  next step).  The batcher is the online analogue of the token-packing
+  PR 9 shipped: capacity is measured in slots and tokens, occupancy is
+  ``live/slots`` per step, and freed-slot reuse latency is a first-class
+  metric.
+- **int8 KV** rides the PR-6 per-channel machinery: the cache stores
+  int8 against calibrated ``[L, N, D]`` scale tables
+  (``models.decoder.calibrate_kv_scales``; offline artifact via
+  ``scripts/quantize_ckpt.py --kv_calib``, self-calibration at warmup
+  otherwise) — half (vs bf16) to a quarter (vs fp32) the cache traffic,
+  which is the decode roofline.
+- **KV HBM budget** (``--kv_hbm_mb``, ``obs.memory.KVBudget``): the
+  declared budget caps the preallocation loudly at construction and
+  refuses oversized streams at admission with the budget math
+  (:class:`~pdnlp_tpu.obs.memory.KVBudgetExceeded`) — never an OOM three
+  layers deep; live occupancy is a ``/metrics`` gauge.
+- **replica failure** (:class:`DecodeRouter`): a dead decode worker's
+  live + waiting streams re-prefill on survivors from ``prompt +
+  emitted-so-far`` — greedy decode is deterministic, so the continuation
+  emits exactly the tokens the dead replica would have (no duplicates,
+  no losses; the chain shows ``requeue`` then a second ``prefill``).
+
+Hop chains (``obs.request``): ``admit → prefill → decode* → complete``,
+with ``decode`` hops carrying ``slot``/``step``/``tokens_out`` so
+``trace_tpu.py request <id>`` reconstructs a stream's whole life.
+"""
+from __future__ import annotations
+
+import queue
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pdnlp_tpu.models import decoder
+from pdnlp_tpu.obs.memory import KVBudget
+from pdnlp_tpu.obs.request import mint_request_id, record_hop
+from pdnlp_tpu.serve.batcher import (
+    DEFAULT_BUCKETS, DeadlineExceeded, QueueFullError, pick_bucket,
+    usable_buckets,
+)
+from pdnlp_tpu.serve.engine import InferenceEngine
+from pdnlp_tpu.serve.metrics import DecodeMetrics, ReplicaMetrics
+from pdnlp_tpu.train import checkpoint as ckpt
+
+#: sentinel closing a stream's token queue
+_DONE = object()
+
+
+def detokenize(tokenizer, ids: Sequence[int]) -> str:
+    """Token ids -> text: wordpiece continuations (``##``) rejoin their
+    word, CJK pieces concatenate bare, latin words get spaces — the
+    inverse of ``data.tokenizer``'s basic+wordpiece split, close enough
+    for a streamed response body."""
+    out: List[str] = []
+    for i in ids:
+        piece = tokenizer.vocab_list[int(i)] \
+            if 0 <= int(i) < tokenizer.vocab_size else "[UNK]"
+        if piece.startswith("##"):
+            if out:
+                out[-1] += piece[2:]
+            else:
+                out.append(piece[2:])
+        else:
+            out.append(piece)
+    return " ".join(out)
+
+
+class DecodeEngine(InferenceEngine):
+    """The classifier engine's checkpoint/mesh/metrics machinery with a
+    generative decode path on top: LM head, slot KV cache, jitted
+    prefill / cache-insert / decode-step programs, and the KV budget.
+
+    The inherited pieces carry over unchanged: template-validated
+    checkpoint swap (trunk only — the LM head is its own small tree),
+    int8 weight serving (``--serve_dtype int8`` quantizes trunk AND head
+    through ``serve.quant``), per-batch HBM sampling, span conventions
+    (``compile`` on a first-seen shape, the steady-state name after).
+    Single-dispatcher contract: all decode/prefill calls come from ONE
+    worker thread (:class:`DecodeBatcher`)."""
+
+    def __init__(self, args, tokenizer=None, *, mesh=None, metrics=None,
+                 tracer=None, slots: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 prefill_rows: Optional[int] = None):
+        super().__init__(args, tokenizer, mesh=mesh, metrics=metrics,
+                         tracer=tracer)
+        cfg = self.cfg
+        self.max_len = int(max_len or getattr(args, "decode_max_len", 0)
+                           or args.max_seq_len)
+        if self.max_len > cfg.max_position:
+            raise ValueError(
+                f"decode_max_len {self.max_len} exceeds {args.model}'s "
+                f"{cfg.max_position}-position table — generated positions "
+                "would gather garbage embeddings; use a long-position "
+                "model or shrink it")
+        # KV precision: auto follows the serve compute dtype; int8 stores
+        # the cache against calibrated per-channel scale tables
+        kv_req = getattr(args, "kv_dtype", "auto") or "auto"
+        if kv_req not in ("auto", "fp32", "bf16", "int8"):
+            raise ValueError(f"kv_dtype must be auto|fp32|bf16|int8, "
+                             f"got {kv_req!r}")
+        self.kv_int8 = kv_req == "int8"
+        self.kv_dtype = (jnp.int8 if self.kv_int8
+                         else {"fp32": jnp.float32,
+                               "bf16": jnp.bfloat16}.get(kv_req, self.dtype))
+        self._kv_scales = None  # (k_scale, v_scale) [L, N, D] once known
+
+        # the declared HBM budget gates the PREALLOCATION (loud refusal at
+        # construction, never an allocator OOM) and caps slots to what it
+        # covers; admission re-checks per stream (KVBudgetExceeded)
+        self.budget = KVBudget(getattr(args, "kv_hbm_mb", 0))
+        requested = int(slots or getattr(args, "decode_slots", 8))
+        self.token_bytes = decoder.kv_cache_bytes(cfg, 1, 1, self.kv_dtype)
+        slot_bytes = self.token_bytes * self.max_len
+        capped = self.budget.cap_slots(requested, slot_bytes)
+        # slots must tile the mesh's data axis; FLOOR so the cap holds
+        m = self.rows_multiple
+        slots_n = max(m, (capped // m) * m)
+        if slots_n * slot_bytes > (self.budget.budget_bytes or
+                                   slots_n * slot_bytes):
+            raise ValueError(
+                f"kv_hbm_mb cannot cover the {m}-slot mesh minimum "
+                f"({m * slot_bytes / 2**20:.1f} MB)")
+        if slots_n < requested:
+            print(f"[serve.decode] kv_hbm_mb caps decode slots "
+                  f"{requested} -> {slots_n} "
+                  f"({slot_bytes / 2**20:.1f} MB/slot)", file=sys.stderr)
+        self.slots = slots_n
+        self.prefill_rows = self.pad_rows(
+            min(self.slots, int(prefill_rows or 8)))
+        # prompt buckets: the serve bucket ladder capped at max_len, with
+        # max_len always present so a requeue continuation (prompt +
+        # emitted, bounded by admission at max_len) always has a bucket
+        bk = usable_buckets(buckets, min(args.max_seq_len, self.max_len))
+        if bk[-1] < self.max_len:
+            bk = bk + (self.max_len,)
+        self.prefill_buckets = bk
+
+        # LM head: MLM-shaped, seeded beside the trunk template; a
+        # trained head loads via load_lm_head.  int8 weight serving
+        # quantizes it through the same serving-form door as the trunk.
+        self._head_template = decoder.init_lm_head(
+            jax.random.key(args.seed + 1), cfg)
+        self.head = self._put(self._serving_form(self._head_template))
+        self.head_path: Optional[str] = None
+
+        self._cache_k = self._cache_v = None
+        self._alloc_cache()
+
+        metrics_ref = self.metrics
+        dtype = self.dtype
+
+        def _prefill_fn(params, head, ids, mask, last_pos):
+            metrics_ref.retraces.inc()  # body runs only while tracing
+            return decoder.prefill(params, head, cfg, ids, mask, last_pos,
+                                   dtype=dtype)
+
+        if self.kv_int8:
+            def _insert_fn(ck, cv, k, v, slot_ids, ks, vs):
+                metrics_ref.retraces.inc()
+                k = decoder.quantize_kv(k, ks[:, None, None])
+                v = decoder.quantize_kv(v, vs[:, None, None])
+                S = k.shape[2]
+                ck = ck.at[:, slot_ids, :S].set(k, mode="drop")
+                cv = cv.at[:, slot_ids, :S].set(v, mode="drop")
+                return ck, cv
+
+            def _decode_fn(params, head, ck, cv, tokens, pos, ks, vs):
+                metrics_ref.retraces.inc()
+                return decoder.decode_step(params, head, cfg, tokens, ck,
+                                           cv, pos, kv_scales=(ks, vs),
+                                           dtype=dtype)
+        else:
+            def _insert_fn(ck, cv, k, v, slot_ids):
+                metrics_ref.retraces.inc()
+                S = k.shape[2]
+                ck = ck.at[:, slot_ids, :S].set(k.astype(ck.dtype),
+                                                mode="drop")
+                cv = cv.at[:, slot_ids, :S].set(v.astype(cv.dtype),
+                                                mode="drop")
+                return ck, cv
+
+            def _decode_fn(params, head, ck, cv, tokens, pos):
+                metrics_ref.retraces.inc()
+                return decoder.decode_step(params, head, cfg, tokens, ck,
+                                           cv, pos, dtype=dtype)
+
+        self._jit_prefill = jax.jit(_prefill_fn)
+        self._jit_insert = jax.jit(_insert_fn, donate_argnums=(0, 1))
+        self._jit_decode = jax.jit(_decode_fn, donate_argnums=(2, 3))
+
+    # ----------------------------------------------------------- lifecycle
+    def _alloc_cache(self) -> None:
+        """(Re)allocate the slot cache — construction, and
+        :meth:`reset_cache` after tests/chaos; never on the hot path."""
+        cfg = self.cfg
+        shape = (cfg.num_layers, self.slots, self.max_len,
+                 cfg.num_heads, cfg.head_dim)
+        sh = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sh = NamedSharding(self.mesh,
+                               PartitionSpec(None, "data", None, None, None))
+
+        def alloc():
+            # two SEPARATE buffers: device_put of one shared zeros array
+            # would alias K and V, and the donated insert/decode calls
+            # would then donate the same buffer twice
+            z = jnp.zeros(shape, self.kv_dtype)
+            return jax.device_put(z, sh) if sh is not None \
+                else jax.device_put(z)
+
+        self._cache_k = alloc()
+        self._cache_v = alloc()
+
+    def reset_cache(self) -> None:
+        self._alloc_cache()
+
+    @property
+    def prompt_limit(self) -> int:
+        """Longest admissible prompt (the widest prefill bucket)."""
+        return int(self.prefill_buckets[-1])
+
+    def check_stream_admissible(self, prompt_len: int,
+                                max_new: int) -> None:
+        """The admission door's capacity + budget math, in one place.
+        On a BUDGETED engine an oversized stream refuses in the budget's
+        own units (:class:`~pdnlp_tpu.obs.memory.KVBudgetExceeded` with
+        the MB math) — the refusal that replaces a mid-decode OOM; an
+        unbudgeted engine reports plain slot capacity."""
+        total = int(prompt_len) + int(max_new)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt_len > self.prompt_limit:
+            raise ValueError(
+                f"prompt of {prompt_len} tokens exceeds the "
+                f"{self.prompt_limit}-token prefill limit")
+        # (no separate budget.check_stream call: construction guarantees
+        # budget >= one slot = max_len positions, so any stream the
+        # budget would refuse also exceeds max_len — ONE door below, in
+        # the budget's units when a budget is declared)
+        if total > self.max_len:
+            if self.budget.budget_bytes is not None:
+                from pdnlp_tpu.obs.memory import KVBudgetExceeded
+
+                raise KVBudgetExceeded(
+                    f"stream needs {total} KV positions "
+                    f"({total * self.token_bytes / 2**20:.1f} MB) but "
+                    f"the budgeted slot holds {self.max_len} "
+                    f"({self.max_len * self.token_bytes / 2**20:.1f} MB "
+                    "under --kv_hbm_mb)")
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the "
+                f"{self.max_len}-position KV slot (--decode_max_len)")
+
+    # ------------------------------------------------------------ KV int8
+    def load_kv_scales(self, path: str) -> None:
+        """Load the manifest-verified int8 KV scale tables
+        (``scripts/quantize_ckpt.py --kv_calib`` sidecar)."""
+        if not self.kv_int8:
+            raise ValueError("KV scale tables only apply to --kv_dtype "
+                             "int8 engines")
+        raw = ckpt.load_raw(path)
+        cfg = self.cfg
+        want = (cfg.num_layers, cfg.num_heads, cfg.head_dim)
+        for key in ("k_scale", "v_scale"):
+            got = tuple(np.asarray(raw[key]).shape)
+            if got != want:
+                raise ValueError(f"KV scale table {key} has shape {got}, "
+                                 f"expected {want} for {self.args.model}")
+        self._kv_scales = (
+            self._put(jnp.asarray(np.asarray(raw["k_scale"], np.float32))),
+            self._put(jnp.asarray(np.asarray(raw["v_scale"], np.float32))))
+
+    def calibrate_kv(self) -> None:
+        """Self-calibrate the int8 KV scale tables from the SERVED params
+        (the seeded synthetic forward ``models.decoder.calibrate_kv_scales``
+        — byte-identical to the offline ``--kv_calib`` artifact for the
+        same params).  Idempotent; a checkpoint swap clears the tables so
+        the next warmup recalibrates."""
+        if not self.kv_int8 or self._kv_scales is not None:
+            return
+        # default calibration width (NOT max_len): the table must be
+        # byte-identical to the offline --kv_calib artifact for the same
+        # params, whatever cache geometry this engine runs
+        ks, vs = decoder.calibrate_kv_scales(self.params, self.cfg,
+                                             dtype=self.dtype)
+        self._kv_scales = (self._put(jnp.asarray(ks)),
+                          self._put(jnp.asarray(vs)))
+
+    def load_checkpoint(self, path: str) -> None:
+        super().load_checkpoint(path)
+        if self.kv_int8:
+            self._kv_scales = None  # stale for the new weights
+            import os
+
+            stem = path.rsplit(".msgpack", 1)[0]
+            for cand in (stem, stem.rsplit(".int8", 1)[0]):
+                sidecar = cand + ".kvscales.msgpack"
+                if os.path.exists(sidecar):
+                    self.load_kv_scales(sidecar)
+                    break
+
+    def load_lm_head(self, path: str) -> None:
+        """Swap the LM head (template-validated like the trunk; an int8
+        artifact validates against the quantized template)."""
+        from pdnlp_tpu.serve.quant import is_quantized, quantize_params
+
+        raw = ckpt.load_raw(path)
+        if self.serve_dtype == "int8":
+            if is_quantized(raw):
+                host = ckpt.from_restored(
+                    raw, self._serving_form(self._head_template), path=path)
+            else:
+                host = quantize_params(
+                    ckpt.from_restored(raw, self._head_template, path=path))
+        else:
+            if is_quantized(raw):
+                raise ValueError(
+                    f"LM head {path!r} is an int8 artifact but this engine "
+                    f"serves {self.serve_dtype!r} — use --serve_dtype int8")
+            host = ckpt.from_restored(raw, self._head_template, path=path)
+        self.head = self._put(host)
+        self.head_path = path
+
+    def _scale_args(self) -> tuple:
+        if not self.kv_int8:
+            return ()
+        if self._kv_scales is None:
+            self.calibrate_kv()
+        return self._kv_scales
+
+    # ------------------------------------------------------------ forward
+    def _shard_batch(self, arrays: Dict[str, np.ndarray]) -> Dict:
+        if self.mesh is None:
+            return arrays
+        from pdnlp_tpu.parallel.sharding import batch_sharding
+
+        sh = batch_sharding(self.mesh)
+        return {k: jax.make_array_from_process_local_data(sh, v)
+                for k, v in arrays.items()}
+
+    def prefill_ids(self, id_lists: Sequence[Sequence[int]],
+                    slot_ids: Sequence[int],
+                    request_ids=None) -> np.ndarray:
+        """Prefill up to ``prefill_rows`` prompts into their claimed slots:
+        bucketed causal forward + K/V scatter; returns each prompt's
+        FIRST-token logits ``[n, vocab]`` (fp32, host).
+
+        Filler rows carry slot id ``self.slots`` — out of bounds, so the
+        scatter DROPS them and a filler row can never touch a live slot.
+        The compile-cache key is ``(bucket, rows, "prefill")``; warmup
+        pre-traces every bucket so steady traffic never compiles."""
+        n = len(id_lists)
+        assert n and n <= self.prefill_rows
+        bucket = pick_bucket(max(len(x) for x in id_lists),
+                             self.prefill_buckets)
+        rows = self.prefill_rows
+        ids = np.zeros((rows, bucket), np.int32)
+        mask = np.zeros((rows, bucket), np.int32)
+        last = np.zeros((rows,), np.int32)
+        slot_arr = np.full((rows,), self.slots, np.int32)  # OOB = dropped
+        for i, (x, s) in enumerate(zip(id_lists, slot_ids)):
+            ids[i, :len(x)] = x
+            mask[i, :len(x)] = 1
+            last[i] = len(x) - 1
+            slot_arr[i] = s
+        key = (int(bucket), int(rows), "prefill")
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+            span_name = "prefill"
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+            span_name = "compile"
+        sharded = self._shard_batch({"ids": ids, "mask": mask})
+        tokens_in = int(mask.sum())
+        with self.tracer.span(span_name, seq=int(bucket), rows=int(rows),
+                              streams=int(n), prefill=True,
+                              tokens=tokens_in, dtype=self.dtype_label,
+                              **self._telemetry_attrs(request_ids),
+                              **self.span_attrs):
+            logits, ks, vs = self._jit_prefill(
+                self.params, self.head, sharded["ids"], sharded["mask"],
+                last)
+            self._cache_k, self._cache_v = self._jit_insert(
+                self._cache_k, self._cache_v, ks, vs, slot_arr,
+                *self._scale_args())
+            out = np.asarray(jax.device_get(logits))
+        return out[:n]
+
+    def decode_batch(self, tokens: np.ndarray, pos: np.ndarray,
+                     live: int, request_ids=None) -> np.ndarray:
+        """One fixed-shape decode step over the whole slot block: tokens
+        ``[slots]`` (current token per slot; dead slots ride with junk),
+        ``pos`` ``[slots]`` write positions.  Returns next-token logits
+        ``[slots, vocab]`` (fp32, host).  The ONE compile-cache key is
+        ``("decode", slots)`` — retrace-free after warmup by
+        construction."""
+        key = ("decode", int(self.slots))
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+            span_name = "decode"
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+            span_name = "compile"
+        tok = np.asarray(tokens, np.int32).reshape(self.slots, 1)
+        p = np.clip(np.asarray(pos, np.int32), 0, self.max_len - 1)
+        with self.tracer.span(span_name, rows=int(self.slots),
+                              live=int(live), decode=True,
+                              dtype=self.dtype_label,
+                              kv=("int8" if self.kv_int8
+                                  else np.dtype(self.kv_dtype).name),
+                              **self._telemetry_attrs(request_ids),
+                              **self.span_attrs):
+            logits, self._cache_k, self._cache_v = self._jit_decode(
+                self.params, self.head, self._cache_k, self._cache_v,
+                tok, p, *self._scale_args())
+            out = np.asarray(jax.device_get(logits))
+        return out
+
+    def infill_ids(self, id_lists: Sequence[Sequence[int]],
+                   request_ids=None) -> np.ndarray:
+        """MLM-infilling scoring: the BIDIRECTIONAL trunk + LM head over
+        bucketed prompts — ``[n, bucket, vocab]`` fp32 logits (the caller
+        reads its ``[MASK]`` positions).  Rides the prefill bucket ladder
+        and compile cache (key ``(bucket, rows, "infill")``)."""
+        n = len(id_lists)
+        assert n and n <= self.prefill_rows
+        bucket = pick_bucket(max(len(x) for x in id_lists),
+                             self.prefill_buckets)
+        rows = self.prefill_rows
+        ids = np.zeros((rows, bucket), np.int32)
+        mask = np.zeros((rows, bucket), np.int32)
+        for i, x in enumerate(id_lists):
+            ids[i, :len(x)] = x
+            mask[i, :len(x)] = 1
+        key = (int(bucket), int(rows), "infill")
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+            span_name = "forward"
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+            span_name = "compile"
+        if not hasattr(self, "_jit_infill"):
+            metrics_ref = self.metrics
+            cfg, dtype = self.cfg, self.dtype
+
+            def _infill_fn(params, head, ids, mask):
+                metrics_ref.retraces.inc()
+                return decoder.infill_logits(params, head, cfg, ids, mask,
+                                             dtype=dtype)
+
+            self._jit_infill = jax.jit(_infill_fn)
+        sharded = self._shard_batch({"ids": ids, "mask": mask})
+        with self.tracer.span(span_name, seq=int(bucket), rows=int(rows),
+                              infill=True, dtype=self.dtype_label,
+                              **self._telemetry_attrs(request_ids),
+                              **self.span_attrs):
+            logits = self._jit_infill(self.params, self.head,
+                                      sharded["ids"], sharded["mask"])
+            out = np.asarray(jax.device_get(logits))
+        return out[:n]
+
+    def warmup_decode(self) -> None:
+        """Pre-trace every reachable decode-path shape: one prefill +
+        insert per bucket (filler slot ids — the cache is untouched), the
+        ONE decode step, and the int8 calibration if pending.  After this
+        call live traffic cannot compile."""
+        self._scale_args()  # int8: calibrate before anything traces
+        for b in self.prefill_buckets:
+            # a bucket-FILLING dummy, so each bucket traces ITS shape
+            # (prefill_ids picks the smallest covering bucket from the
+            # ids' length); the OOB slot id drops the cache write
+            self.prefill_ids([[self.tokenizer.cls_id] * b], [self.slots])
+        tok = np.zeros((self.slots,), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        self.decode_batch(tok, pos, live=0)
+
+    def kv_snapshot(self) -> Dict:
+        """JSON-ready KV/budget block for snapshots and ``/metrics``."""
+        return {
+            **self.budget.snapshot(),
+            "slots": int(self.slots),
+            "max_len": int(self.max_len),
+            "kv_dtype": ("int8" if self.kv_int8
+                         else str(np.dtype(self.kv_dtype).name)),
+            "cache_bytes": decoder.kv_cache_bytes(
+                self.cfg, self.slots, self.max_len, self.kv_dtype),
+        }
+
+
+class DecodeStream:
+    """A caller's handle on one generative request — future AND iterator:
+    :meth:`result` blocks for the full generation, :meth:`tokens` yields
+    token ids as they are produced (the streaming-response surface
+    ``serve_tpu.py --decode`` prints from)."""
+
+    __slots__ = ("rid", "prompt_ids", "max_new_tokens", "deadline",
+                 "submitted", "born", "first_token_at", "last_token_at",
+                 "emitted", "replica", "slot", "_q", "_event", "_error")
+
+    def __init__(self, prompt_ids: List[int], max_new_tokens: int,
+                 deadline: Optional[float] = None):
+        self.rid = mint_request_id()
+        self.prompt_ids = list(prompt_ids)
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = deadline
+        self.submitted = time.monotonic()
+        self.born = self.submitted
+        self.first_token_at: Optional[float] = None
+        self.last_token_at: Optional[float] = None
+        self.emitted: List[int] = []
+        self.replica: Optional[int] = None
+        self.slot: Optional[int] = None
+        self._q: "queue.Queue" = queue.Queue()
+        self._event = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    # --- worker half ---
+    def _push(self, token: int) -> float:
+        """Record one generated token; returns the inter-token gap in
+        seconds (0.0 for the first — the caller observes ttft instead)."""
+        now = time.monotonic()
+        gap = 0.0 if self.last_token_at is None \
+            else now - self.last_token_at
+        if self.first_token_at is None:
+            self.first_token_at = now
+        self.last_token_at = now
+        self.emitted.append(int(token))
+        self._q.put(int(token))
+        return gap
+
+    def _finish(self, error: Optional[BaseException] = None) -> bool:
+        if self._event.is_set():
+            return False
+        self._error = error
+        self._event.set()
+        self._q.put(_DONE)
+        return True
+
+    # --- caller half ---
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def tokens(self, timeout: Optional[float] = 60.0):
+        """Yield generated token ids as they arrive; raises the stream's
+        error (if any) after the last token."""
+        while True:
+            item = self._q.get(timeout=timeout)
+            if item is _DONE:
+                break
+            yield item
+        if self._error is not None:
+            raise self._error
+
+    def result(self, timeout: Optional[float] = 60.0) -> List[int]:
+        """Block until the stream finishes; returns ALL generated ids."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("stream still generating")
+        if self._error is not None:
+            raise self._error
+        return list(self.emitted)
+
+
+class _Slot:
+    __slots__ = ("stream", "pos", "next_token")
+
+    def __init__(self, stream: DecodeStream, pos: int, next_token: int):
+        self.stream = stream
+        self.pos = pos              # write position of next_token
+        self.next_token = next_token
+
+
+class DecodeBatcher:
+    """Continuous batching over one :class:`DecodeEngine`: a single
+    worker owns the engine (the repo's one-dispatcher contract) and loops
+    claim → prefill → decode-step, with streams joining freed slots and
+    finished streams leaving BETWEEN steps — the decode batch shape never
+    changes, only which rows are live.
+
+    ``on_death(replica, orphans, error)``: installed by
+    :class:`DecodeRouter`; a worker that loses its engine hands over its
+    live + waiting streams instead of failing them."""
+
+    def __init__(self, engine: DecodeEngine, *, max_waiting: int = 256,
+                 default_max_new: Optional[int] = None, replica: int = 0,
+                 on_death: Optional[Callable] = None,
+                 rmetrics: Optional[ReplicaMetrics] = None,
+                 dmetrics: Optional[DecodeMetrics] = None):
+        self.engine = engine
+        self.tracer = engine.tracer
+        self.replica = int(replica)
+        engine.span_attrs.setdefault("replica", self.replica)
+        self.max_waiting = int(max_waiting)
+        self.default_max_new = int(
+            default_max_new
+            or getattr(engine.args, "max_new_tokens", 32))
+        self.eos_id = engine.tokenizer.sep_id
+        self.on_death = on_death
+        self.metrics = dmetrics or DecodeMetrics()
+        self.rmetrics = rmetrics or ReplicaMetrics()
+        self._slots: List[Optional[_Slot]] = [None] * engine.slots
+        self._free: deque = deque(range(engine.slots))
+        self._freed_at: Dict[int, float] = {}
+        self._waiting: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = False
+        self._poison: Optional[BaseException] = None
+        self.dead = False
+        self._worker: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "DecodeBatcher":
+        if self._worker is None and not self.dead:
+            self._stop = False
+            self._worker = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"pdnlp-decode-{self.replica}")
+            self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        if self._worker is None:
+            return
+        if drain:
+            with self._lock:
+                while (not self.dead and not self._stop
+                       and (self._waiting or self._live_count())):
+                    self._wake.wait(timeout=0.05)
+        with self._lock:
+            self._stop = True
+            self._wake.notify_all()
+        self._worker.join(timeout=30)
+        self._worker = None
+        leftovers = []
+        with self._lock:
+            leftovers += [s for s in self._waiting]
+            leftovers += [sl.stream for sl in self._slots if sl is not None]
+            self._waiting.clear()
+            self._slots = [None] * self.engine.slots
+            self._free = deque(range(self.engine.slots))
+        for s in leftovers:
+            if s._finish(RuntimeError("decode batcher stopped")):
+                record_hop(self.tracer, s.rid, "failed",
+                           error="batcher stopped")
+
+    def __enter__(self) -> "DecodeBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def kill(self, error: Optional[BaseException] = None) -> None:
+        """Chaos hook (tests / ``bench.py --decode``): the worker raises
+        ``error`` before its next step — exactly the path a real engine
+        failure takes."""
+        with self._lock:
+            self._poison = error or RuntimeError("injected replica kill")
+            self._wake.notify_all()
+
+    # ------------------------------------------------------------- submit
+    def _live_count(self) -> int:
+        return sum(1 for sl in self._slots if sl is not None)
+
+    @property
+    def load(self) -> int:
+        with self._lock:
+            return self._live_count() + len(self._waiting)
+
+    def submit_ids(self, ids: Sequence[int],
+                   max_new_tokens: Optional[int] = None,
+                   deadline_ms: Optional[float] = None) -> DecodeStream:
+        """Admit one generative stream; returns its
+        :class:`DecodeStream`.  Refusals are LOUD and typed: capacity
+        (``ValueError``), KV budget
+        (:class:`~pdnlp_tpu.obs.memory.KVBudgetExceeded`), queue bound
+        (:class:`~pdnlp_tpu.serve.batcher.QueueFullError`)."""
+        ids = list(ids)
+        if not ids:
+            raise ValueError("empty prompt: submit at least one token id")
+        max_new = int(self.default_max_new if max_new_tokens is None
+                      else max_new_tokens)  # an explicit 0 must REFUSE,
+        #                                     not silently take the default
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        stream = DecodeStream(ids, max_new, deadline)
+        tr = self.tracer
+        try:
+            self.engine.check_stream_admissible(len(ids), max_new)
+        except BaseException as e:
+            self.metrics.rejected_total.inc()
+            record_hop(tr, stream.rid, "rejected",
+                       reason=type(e).__name__)
+            raise
+        with self._lock:
+            if self.dead or self._stop or self._worker is None:
+                raise RuntimeError("decode batcher is not running")
+            if len(self._waiting) >= self.max_waiting:
+                self.metrics.rejected_total.inc()
+                record_hop(tr, stream.rid, "rejected")
+                raise QueueFullError(
+                    f"decode queue full ({len(self._waiting)}"
+                    f"/{self.max_waiting} waiting streams)")
+            stream.replica = self.replica
+            self._waiting.append(stream)
+            self.metrics.streams_total.inc()
+            self.metrics.waiting.set(len(self._waiting))
+            record_hop(tr, stream.rid, "admit", streamed=True,
+                       tokens=len(ids), max_new=max_new,
+                       replica=self.replica)
+            self._wake.notify()
+        return stream
+
+    def _adopt(self, stream: DecodeStream) -> bool:
+        """Router re-home: enqueue an orphan stream's CONTINUATION
+        (prompt + emitted-so-far re-prefills here; greedy decode then
+        emits exactly the tokens the dead replica would have).  Bypasses
+        admission — the stream was already accepted once."""
+        with self._lock:
+            if self.dead or self._stop or self._worker is None:
+                return False
+            stream.replica = self.replica
+            self._waiting.append(stream)
+            self.metrics.waiting.set(len(self._waiting))
+            self.rmetrics.requeued_in.inc()
+            self._wake.notify()
+        return True
+
+    # ------------------------------------------------------------- worker
+    def _run(self) -> None:
+        try:
+            while True:
+                claims: List[tuple] = []
+                with self._lock:
+                    if self._poison is not None:
+                        raise self._poison
+                    if self._stop:
+                        return
+                    self._expire_waiting_locked()
+                    while self._free and self._waiting:
+                        slot = self._free.popleft()
+                        stream = self._waiting.popleft()
+                        freed = self._freed_at.pop(slot, None)
+                        if freed is not None:
+                            self.rmetrics.slot_reuse_ms.observe(
+                                (time.monotonic() - freed) * 1e3)
+                        stream.slot = slot
+                        # placeholder NOW: if the prefill below dies, the
+                        # claimed stream is already in _slots and the
+                        # death path re-homes it instead of losing it
+                        self._slots[slot] = _Slot(stream, 0, 0)
+                        claims.append((slot, stream))
+                    self.metrics.waiting.set(len(self._waiting))
+                    live = self._live_count()
+                    if not claims and live == 0:
+                        if self._stop:
+                            return
+                        self._wake.notify_all()  # unblock stop(drain)
+                        self._wake.wait(timeout=0.05)
+                        continue
+                if claims:
+                    self._prefill(claims)
+                if self._live_count():
+                    self._decode_step()
+                with self._lock:
+                    self._wake.notify_all()
+        except BaseException as e:  # noqa: BLE001 — a dead engine must
+            self._die(e)           # never strand callers or streams
+
+    def _expire_waiting_locked(self) -> None:
+        now = time.monotonic()
+        keep: deque = deque()
+        for s in self._waiting:
+            if s.deadline is not None and now >= s.deadline:
+                self.metrics.deadline_expired_total.inc()
+                if s._finish(DeadlineExceeded(
+                        "deadline passed while waiting for a slot")):
+                    record_hop(self.tracer, s.rid, "deadline")
+            else:
+                keep.append(s)
+        self._waiting = keep
+
+    def _prefill(self, claims: List[tuple]) -> None:
+        """Prefill claimed streams (chunked to the engine's fixed prefill
+        rows), emit each stream's FIRST token from the prefill logits,
+        and enter survivors into the decode batch."""
+        rows = self.engine.prefill_rows
+        for i in range(0, len(claims), rows):
+            chunk = claims[i:i + rows]
+            prompts = [s.prompt_ids + s.emitted for _, s in chunk]
+            logits = self.engine.prefill_ids(
+                prompts, [slot for slot, _ in chunk],
+                request_ids=[s.rid for _, s in chunk])
+            self.metrics.prefills_total.inc()
+            self.metrics.prefill_tokens_total.inc(
+                sum(len(p) for p in prompts))
+            now = time.monotonic()
+            for j, (slot, stream) in enumerate(chunk):
+                record_hop(self.tracer, stream.rid, "prefill", slot=slot,
+                           tokens_in=len(prompts[j]),
+                           replica=self.replica)
+                self.metrics.ttft_ms.observe((now - stream.born) * 1e3)
+                tok = int(np.argmax(logits[j]))
+                self._advance(slot, stream, tok, pos=len(prompts[j]))
+            self._update_kv_gauge()
+
+    def _advance(self, slot: int, stream: DecodeStream, tok: int, *,
+                 pos: int) -> None:
+        """Handle one newly produced token for ``stream``: emit it (or
+        the EOS/stop decision), and either keep the slot live with the
+        token as the next decode input or finish + free the slot.
+        ``pos`` = the write position the NEXT decode step would use."""
+        remaining = stream.max_new_tokens - len(stream.emitted)
+        finish = False
+        if tok == self.eos_id or remaining <= 0:
+            finish = True       # EOS is a stop decision, not an emission
+        else:
+            gap = stream._push(tok)
+            if gap > 0.0:
+                self.metrics.intertoken_ms.observe(gap * 1e3)
+            self.metrics.tokens_out_total.inc()
+            if (len(stream.emitted) >= stream.max_new_tokens
+                    or pos >= self.engine.max_len):
+                finish = True
+        with self._lock:
+            if finish:
+                self._slots[slot] = None
+                self._free.append(slot)
+                self._freed_at[slot] = time.monotonic()
+            else:
+                self._slots[slot] = _Slot(stream, pos, tok)
+        if finish:
+            if stream._finish():
+                record_hop(self.tracer, stream.rid, "complete",
+                           replica=self.replica, slot=slot,
+                           tokens_out=len(stream.emitted))
+
+    def _decode_step(self) -> None:
+        """ONE fixed-shape decode step over the slot block; live rows
+        advance their streams, dead rows ride as junk."""
+        tokens = np.zeros((self.engine.slots,), np.int32)
+        pos = np.zeros((self.engine.slots,), np.int32)
+        with self._lock:
+            live = [(i, sl) for i, sl in enumerate(self._slots)
+                    if sl is not None]
+            for i, sl in live:
+                tokens[i] = sl.next_token
+                pos[i] = sl.pos
+        if not live:
+            return
+        logits = self.engine.decode_batch(
+            tokens, pos, live=len(live),
+            request_ids=[sl.stream.rid for _, sl in live])
+        self.metrics.decode_steps_total.inc()
+        self.rmetrics.slot_occupancy.observe(
+            len(live) / float(self.engine.slots))
+        self.rmetrics.batches_total.inc()
+        for i, sl in live:
+            tok = int(np.argmax(logits[i]))
+            # hop BEFORE _advance so a completing stream's terminal stays
+            # last; tokens_out = cumulative emissions including this step
+            # (EOS is a stop decision, not an emission)
+            emitted = len(sl.stream.emitted)
+            record_hop(self.tracer, sl.stream.rid, "decode", slot=i,
+                       step=emitted,
+                       tokens_out=emitted + (tok != self.eos_id),
+                       replica=self.replica)
+            self._advance(i, sl.stream, tok, pos=sl.pos + 1)
+        self._update_kv_gauge()
+
+    def _update_kv_gauge(self) -> None:
+        with self._lock:
+            live_tokens = sum(sl.pos for sl in self._slots
+                              if sl is not None)
+            live_slots = self._live_count()
+        nbytes = live_tokens * self.engine.token_bytes
+        self.engine.budget.set_live(nbytes)
+        self.metrics.kv_bytes_live.set(nbytes)
+        self.metrics.kv_slots_live.set(live_slots)
+
+    def _die(self, error: BaseException) -> None:
+        """Worker death: collect every stream this replica owes an answer
+        (live slots + waiting) and hand them to the router — or fail them
+        loudly when there is no router to re-home onto."""
+        with self._lock:
+            self.dead = True
+            orphans = [sl.stream for sl in self._slots if sl is not None]
+            orphans += list(self._waiting)
+            self._waiting.clear()
+            self._slots = [None] * self.engine.slots
+            self._free = deque(range(self.engine.slots))
+            self.rmetrics.ejections.inc()
+            self._wake.notify_all()
+        if self.on_death is not None:
+            self.on_death(self.replica, orphans, error)
+        else:
+            for s in orphans:
+                if s._finish(error):
+                    record_hop(self.tracer, s.rid, "failed",
+                               error=type(error).__name__)
+
+    # ------------------------------------------------------------ surface
+    def warmup(self) -> None:
+        self.engine.warmup_decode()
+
+    def snapshot(self) -> Dict:
+        return {
+            "decode": self.metrics.snapshot(),
+            "replica": self.rmetrics.snapshot(),
+            "kv": self.engine.kv_snapshot(),
+            "engine": self.engine.metrics.snapshot(),
+        }
+
+
+class DecodeRouter:
+    """N decode engines behind one door: least-loaded stream placement,
+    and on a replica death the orphan streams RE-PREFILL on survivors
+    from ``prompt + emitted`` — greedy decode is deterministic, so the
+    continuation yields exactly the tokens the dead replica would have
+    produced (the ``--decode`` bench gates no-duplicate/no-loss through a
+    mid-storm kill).  Deliberately lean next to :class:`ReplicaRouter`:
+    decode streams are long-lived and slot-bound, so health is the
+    worker's own liveness (an engine failure IS the worker dying), not a
+    heartbeat sidecar."""
+
+    def __init__(self, engines: Sequence[DecodeEngine], *,
+                 max_waiting: int = 256,
+                 default_max_new: Optional[int] = None):
+        assert engines
+        self.tracer = engines[0].tracer
+        self.batchers = [
+            DecodeBatcher(e, max_waiting=max_waiting,
+                          default_max_new=default_max_new, replica=i,
+                          on_death=self._on_death)
+            for i, e in enumerate(engines)]
+
+    def start(self) -> "DecodeRouter":
+        for b in self.batchers:
+            b.start()
+        return self
+
+    def warmup(self) -> None:
+        for b in self.batchers:
+            b.warmup()
+
+    def wait_ready(self) -> bool:
+        return any(not b.dead for b in self.batchers)
+
+    def stop(self, drain: bool = True) -> None:
+        for b in self.batchers:
+            b.stop(drain=drain)
+
+    def engine(self, i: int = 0) -> DecodeEngine:
+        return self.batchers[i].engine
+
+    def alive(self) -> List[DecodeBatcher]:
+        return [b for b in self.batchers
+                if not b.dead and b._worker is not None]
+
+    def submit_ids(self, ids: Sequence[int],
+                   max_new_tokens: Optional[int] = None,
+                   deadline_ms: Optional[float] = None) -> DecodeStream:
+        alive = self.alive()
+        if not alive:
+            raise RuntimeError("no live decode replica")
+        target = min(alive, key=lambda b: b.load)
+        return target.submit_ids(ids, max_new_tokens=max_new_tokens,
+                                 deadline_ms=deadline_ms)
+
+    def kill(self, replica: int,
+             error: Optional[BaseException] = None) -> None:
+        self.batchers[replica].kill(error)
+
+    def _on_death(self, replica: int, orphans: List[DecodeStream],
+                  error: BaseException) -> None:
+        alive = self.alive()
+        for stream in orphans:
+            homed = False
+            for target in sorted(alive, key=lambda b: b.load):
+                # hop BEFORE the adopt: once adopted, the target's worker
+                # may prefill (even complete) the stream immediately, and
+                # a requeue hop landing after the terminal would fail
+                # chain validation.  If the target died in the window the
+                # hop names a replica that never took the stream — rare,
+                # benign (non-terminal), and the next attempt records its
+                # own hop; the requeued_out counter stays truthful by
+                # incrementing only on a successful re-home.
+                record_hop(self.tracer, stream.rid, "requeue",
+                           from_replica=replica,
+                           to_replica=target.replica, streamed=True,
+                           tokens_emitted=len(stream.emitted))
+                if target._adopt(stream):
+                    self.batchers[replica].rmetrics.requeued_out.inc()
+                    homed = True
+                    break
+            if not homed:
+                if stream._finish(error):
+                    record_hop(self.tracer, stream.rid, "failed",
+                               error=type(error).__name__)
+
+    def snapshot(self) -> Dict:
+        return {
+            "replicas": {str(b.replica): b.snapshot()
+                         for b in self.batchers},
+            "alive": len(self.alive()),
+        }

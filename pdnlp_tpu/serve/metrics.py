@@ -114,6 +114,17 @@ class ReplicaMetrics:
     - ``retries`` — failed-batch requests it re-dispatched after a replica
       failure;
     - ``ejections`` — times this slot's replica was ejected (dead/stalled).
+
+    Generative decoding adds the slot view (the decode engine's unit of
+    capacity is a KV-cache SLOT, not a queue row):
+
+    - ``slot_occupancy`` — per decode step, live slots / usable slots:
+      the continuous-batching health number (streams joining freed slots
+      between steps is what keeps it near 1.0 under load);
+    - ``slot_reuse_ms`` — freed-slot reuse latency: how long a slot a
+      finished stream vacated sat idle before a waiting stream claimed it
+      (the online analogue of packing's fill ratio — high occupancy with
+      slow reuse means admission, not capacity, is the bottleneck).
     """
 
     def __init__(self) -> None:
@@ -121,6 +132,8 @@ class ReplicaMetrics:
         self.inflight = Gauge()
         self.batch_occupancy = Histogram()
         self.fill_ratio = Histogram()
+        self.slot_occupancy = Histogram()
+        self.slot_reuse_ms = Histogram()
         self.batches_total = Counter()
         self.requests_total = Counter()
         self.requeued_out = Counter()
@@ -140,6 +153,59 @@ class ReplicaMetrics:
             "ejections": self.ejections.value,
             "batch_occupancy": self.batch_occupancy.snapshot(),
             "fill_ratio": self.fill_ratio.snapshot(),
+            "slot_occupancy": self.slot_occupancy.snapshot(),
+            "slot_reuse_ms": self.slot_reuse_ms.snapshot(),
+        }
+
+
+class DecodeMetrics:
+    """Generative-decoding observability (``serve.decode``), in the units
+    that tier actually optimizes — TOKENS and inter-token gaps, not
+    request rows:
+
+    - ``streams_total`` / ``rejected_total`` / ``deadline_expired_total``
+      — stream admission accounting (rejects include KV-budget refusals);
+    - ``prefills_total`` / ``prefill_tokens_total`` — bucketed prompt
+      forwards and the prompt tokens they consumed;
+    - ``decode_steps_total`` / ``tokens_out_total`` — fixed-shape decode
+      dispatches and the tokens they produced (tokens/s/chip = the bench
+      headline);
+    - ``ttft_ms`` — submit -> first token (the prefill-visible latency);
+    - ``intertoken_ms`` — gap between consecutive tokens of one stream
+      (p99 is the streaming SLO ``bench.py --decode`` gates);
+    - ``waiting`` — streams queued for a free slot;
+    - ``kv_bytes_live`` / ``kv_slots_live`` — live KV occupancy (the
+      ``--kv_hbm_mb`` budget gauge on ``/metrics``).
+    """
+
+    def __init__(self) -> None:
+        self.streams_total = Counter()
+        self.rejected_total = Counter()
+        self.deadline_expired_total = Counter()
+        self.prefills_total = Counter()
+        self.prefill_tokens_total = Counter()
+        self.decode_steps_total = Counter()
+        self.tokens_out_total = Counter()
+        self.ttft_ms = Histogram()
+        self.intertoken_ms = Histogram()
+        self.waiting = Gauge()
+        self.kv_bytes_live = Gauge()
+        self.kv_slots_live = Gauge()
+
+    def snapshot(self) -> Dict:
+        return {
+            "streams_total": self.streams_total.value,
+            "rejected_total": self.rejected_total.value,
+            "deadline_expired_total": self.deadline_expired_total.value,
+            "prefills_total": self.prefills_total.value,
+            "prefill_tokens_total": self.prefill_tokens_total.value,
+            "decode_steps_total": self.decode_steps_total.value,
+            "tokens_out_total": self.tokens_out_total.value,
+            "ttft_ms": self.ttft_ms.snapshot(),
+            "intertoken_ms": self.intertoken_ms.snapshot(),
+            "waiting": self.waiting.value,
+            "kv_bytes_live": self.kv_bytes_live.value,
+            "kv_slots_live": self.kv_slots_live.value,
         }
 
 

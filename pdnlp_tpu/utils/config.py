@@ -194,6 +194,33 @@ class Args:
                                                   # "" = long requests
                                                   # truncate at the largest
                                                   # bucket, the legacy path)
+    decode_slots: int = 8                         # generative serving
+                                                  # (serve/decode.py): KV-
+                                                  # cache slots = the fixed
+                                                  # decode batch rows;
+                                                  # continuous batching
+                                                  # keeps them full
+    decode_max_len: int = 0                       # per-slot KV positions
+                                                  # (prompt + generated);
+                                                  # 0 = max_seq_len
+    max_new_tokens: int = 32                      # default generation
+                                                  # budget per stream
+    kv_dtype: str = "auto"                        # KV-cache precision:
+                                                  # auto (= the serve
+                                                  # compute dtype) | fp32 |
+                                                  # bf16 | int8 (per-
+                                                  # channel scale tables —
+                                                  # calibrated at warmup or
+                                                  # loaded from scripts/
+                                                  # quantize_ckpt.py
+                                                  # --kv_calib)
+    kv_hbm_mb: float = 0.0                        # declared KV-cache HBM
+                                                  # budget per decode
+                                                  # engine (obs.memory.
+                                                  # KVBudget): caps slots
+                                                  # at construction, loud
+                                                  # refusal (never OOM) at
+                                                  # admission; 0 = off
     prefetch: int = 2                             # loader collation lookahead
     pipeline: str = "auto"                        # input pipeline (data/
                                                   # pipeline.py): auto|

@@ -71,14 +71,32 @@ through a decision-recording, auto-reverting actuation path — controller
 state rides ``/metrics`` and is summarized in ``/healthz``, and every
 knob turn is reconstructable via ``trace_tpu.py decisions``.
 
+``--decode`` serves **generative decoding** instead of classification
+(:mod:`pdnlp_tpu.serve.decode`): one prompt per stdin line, tokens
+STREAMED back as they decode (``<line>\\ttok\\t<piece>`` per token, a
+closing ``<line>\\tgen\\t<text>``).  Each replica owns a preallocated
+slot-indexed KV cache (``--decode_slots`` × ``--decode_max_len``
+positions, ``--kv_dtype fp32|bf16|int8`` — int8 rides calibrated
+per-channel scale tables, ``scripts/quantize_ckpt.py --kv_calib``),
+bucketed prefill + one fixed-shape decode step (retrace-free after
+warmup), and continuous batching: streams claim freed slots between
+steps.  ``--kv_hbm_mb`` declares a KV budget (loud refusal at admission,
+never an OOM); ``--replicas N`` decodes behind a
+:class:`~pdnlp_tpu.serve.decode.DecodeRouter` whose kill-recovery
+re-prefills orphan streams on survivors with no duplicated or lost
+tokens.  ``--max_new_tokens`` bounds each stream's generation.
+
 Serve-local flags (not ``Args`` fields): ``--checkpoint`` (default: newest
 under ``--output_dir``), ``--buckets 32,64,128``, ``--max_batch_size``,
 ``--max_wait_ms``, ``--max_queue``, ``--deadline_ms``, ``--replicas``,
 ``--hedge_ms``, ``--replica_stall_s``, ``--serve_pack``, ``--controller``,
 ``--min_replicas``, ``--fleet``, ``--shadow_fraction``,
-``--canary_fraction``, ``--degrade_at``, ``--rollout``, ``--input``,
+``--canary_fraction``, ``--degrade_at``, ``--rollout``, ``--decode``,
+``--input``,
 ``--output``, ``--metrics_path``, ``--no_mesh``.  Everything else (model, dtype, vocab, output_dir, ...) is
-the standard ``Args`` CLI.
+the standard ``Args`` CLI (the decode knobs — ``--decode_slots``,
+``--decode_max_len``, ``--max_new_tokens``, ``--kv_dtype``,
+``--kv_hbm_mb`` — are ``Args`` fields).
 """
 from __future__ import annotations
 
@@ -265,6 +283,155 @@ def build_fleet(args: Args, specs, *, use_mesh: bool = True,
                        canary_fraction=canary_fraction, tracer=tracer)
 
 
+def build_decode_pool(args: Args, replicas: int, *,
+                      checkpoint: Optional[str] = None,
+                      use_mesh: bool = True, buckets=DEFAULT_BUCKETS,
+                      max_waiting: int = 256):
+    """Generative serving pool: ``replicas`` :class:`DecodeEngine`\\ s —
+    device-group meshes when the host has them, plain jit otherwise —
+    behind a :class:`DecodeRouter` (1 replica included: the router is the
+    one submit/kill/snapshot surface either way).  Each engine owns a
+    preallocated slot KV cache (``--decode_slots`` × ``--decode_max_len``
+    positions, ``--kv_dtype`` precision, gated by ``--kv_hbm_mb``)."""
+    import jax
+
+    from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, get_or_build_vocab
+    from pdnlp_tpu.serve import DecodeEngine, DecodeRouter
+
+    groups: list = [None] * replicas
+    if use_mesh:
+        from pdnlp_tpu.parallel import make_mesh
+
+        devices = list(jax.devices())
+        if args.num_devices:
+            devices = devices[: args.num_devices]
+        per = len(devices) // replicas
+        if per >= 1:
+            groups = [make_mesh(devices=devices[i * per:(i + 1) * per])
+                      for i in range(replicas)]
+    tok = WordPieceTokenizer(get_or_build_vocab(args))
+    engines = [DecodeEngine(args, tokenizer=tok, mesh=groups[i],
+                            buckets=buckets) for i in range(replicas)]
+    tracer = engines[0].tracer
+    for e in engines[1:]:
+        e.tracer = tracer  # one span/hop stream for the whole pool
+    if checkpoint is None:
+        checkpoint = _latest_checkpoint(args)
+    if checkpoint:
+        for e in engines:
+            e.load_checkpoint(checkpoint)
+        rank0_print(f"decoding from {checkpoint} on {replicas} "
+                    "replica(s)", file=sys.stderr)
+    else:
+        rank0_print("WARNING: no checkpoint found — decoding from "
+                    "untrained init weights (smoke mode)", file=sys.stderr)
+    return DecodeRouter(engines, max_waiting=max_waiting,
+                        default_max_new=args.max_new_tokens)
+
+
+def serve_decode(args: Args, argv_flags: dict) -> None:
+    """The ``--decode`` online loop: one prompt per stdin line, tokens
+    STREAMED to stdout as they are generated.
+
+    Output protocol (line-oriented, ``<line#>\\t<kind>\\t<payload>``):
+    ``tok`` lines carry each token's text the moment it decodes, ``gen``
+    closes the stream with the full generation, ``ERROR`` reports a
+    refusal (queue/KV budget) without killing the server.  Results drain
+    in submission order; a window of in-flight streams keeps the decode
+    slots full (continuous batching needs waiting streams to claim freed
+    slots)."""
+    from collections import deque
+
+    from pdnlp_tpu.serve.decode import detokenize
+
+    pool = build_decode_pool(
+        args, argv_flags["replicas"],
+        checkpoint=argv_flags["checkpoint"],
+        use_mesh=argv_flags["use_mesh"], buckets=argv_flags["buckets"],
+        max_waiting=argv_flags["max_queue"])
+    engine = pool.engine(0)
+    pool.start()
+    pool.warmup()
+    rank0_print("ready — one prompt per line on stdin (EOF to exit); "
+                "tokens stream as `<line>\\ttok\\t<piece>`",
+                file=sys.stderr)
+
+    exporter = None
+    if args.metrics_port or args.flight_recorder:
+        from pdnlp_tpu.obs import memory_snapshot
+        from pdnlp_tpu.obs.exporter import build_from_args
+
+        exporter = build_from_args(
+            args, {"decode": pool.snapshot, "memory": memory_snapshot},
+            "flight_decode.jsonl")
+
+    tokenizer = engine.tokenizer
+    max_new = args.max_new_tokens
+    deadline_ms = argv_flags["deadline_ms"]
+    # leave generation room inside the slot: the prompt may use at most
+    # max_len - max_new positions
+    prompt_budget = max(1, engine.max_len - max_new)
+    # enough in-flight streams to keep every slot claimable, capped at
+    # the waiting-queue bound so pipelining can never walk submissions
+    # into the reject tier
+    window = min(2 * sum(b.engine.slots for b in pool.batchers),
+                 argv_flags["max_queue"])
+    inflight: deque = deque()
+
+    def emit(idx, stream) -> None:
+        try:
+            for tid in stream.tokens(timeout=120):
+                print(f"{idx}\ttok\t{tokenizer.vocab_list[tid]}",
+                      flush=True)
+            print(f"{idx}\tgen\t{detokenize(tokenizer, stream.emitted)}",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 — stream failed: report,
+            print(f"{idx}\tERROR\t{type(e).__name__}: {e}", flush=True)
+
+    def flush_artifacts() -> None:
+        import json
+
+        if exporter is not None:
+            exporter.stop(final_flight=True)
+        snap = pool.snapshot()
+        if argv_flags["metrics_path"]:
+            from pdnlp_tpu.serve.metrics import _save_json
+
+            _save_json(snap, argv_flags["metrics_path"])
+        else:
+            rank0_print(json.dumps(snap, indent=2), file=sys.stderr)
+        trace_path = engine.tracer.flush()
+        if trace_path:
+            rank0_print(f"[obs] spans -> {trace_path}", file=sys.stderr)
+
+    n = 0
+    try:
+        for line in sys.stdin:
+            text = line.strip()
+            if not text:
+                continue
+            ids = tokenizer.encode_ids(text, prompt_budget)
+            try:
+                inflight.append((n, pool.submit_ids(
+                    ids, max_new_tokens=max_new,
+                    deadline_ms=deadline_ms)))
+            except Exception as e:  # noqa: BLE001 — refusal: report
+                print(f"{n}\tERROR\t{type(e).__name__}: {e}", flush=True)
+                n += 1
+                continue
+            n += 1
+            while len(inflight) >= window:
+                emit(*inflight.popleft())
+    except _ShutdownRequested as e:
+        rank0_print(f"[serve] {e} — draining {len(inflight)} stream(s), "
+                    "then shutting down", file=sys.stderr)
+    finally:
+        while inflight:
+            emit(*inflight.popleft())
+        pool.stop(drain=True)
+        flush_artifacts()
+
+
 class _ShutdownRequested(KeyboardInterrupt):
     """SIGTERM/SIGINT: stop intake, drain, flush — never drop silently."""
 
@@ -307,9 +474,25 @@ def main(argv=None) -> None:
     no_mesh = "--no_mesh" in argv
     if no_mesh:
         argv.remove("--no_mesh")
+    decode_mode = "--decode" in argv
+    if decode_mode:
+        argv.remove("--decode")
     args = parse_cli(argv, base=Args())
     buckets = (tuple(int(b) for b in buckets_s.split(",")) if buckets_s
                else DEFAULT_BUCKETS)
+    if decode_mode:
+        # generative serving: its own pool/loop — the classifier flags
+        # that have no decode meaning are rejected up front
+        if fleet_spec or in_path or serve_pack != "auto":
+            sys.exit("serve_tpu: --decode is the generative online path — "
+                     "drop --fleet/--input/--serve_pack")
+        _install_signal_handlers()
+        return serve_decode(args, {
+            "replicas": replicas, "checkpoint": checkpoint,
+            "use_mesh": not no_mesh, "buckets": buckets,
+            "max_queue": max_queue, "metrics_path": metrics_path,
+            "deadline_ms": deadline,
+        })
     # chunked prefill (--serve_long_widths "512,1024"): single-replica
     # frontend only — the router's queues stay short-width; a long request
     # hitting a router deployment truncates at the largest bucket as before

@@ -1,0 +1,39 @@
+"""R16 negatives: the donated in-place fix, non-cache concatenation in a
+decode loop, and one-time cache assembly outside any decode loop."""
+import jax
+import jax.numpy as jnp
+
+
+def greedy_decode(params, decode_step, token, k_cache, v_cache, pos):
+    for _ in range(32):
+        logits, k_new, v_new = decode_step(params, token, k_cache, v_cache)
+        # THE fix: dynamic update into the preallocated (donated) buffer
+        k_cache = k_cache.at[:, :, pos].set(k_new)
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v_new, (0, 0, pos))
+        token = logits.argmax(-1)
+        pos = pos + 1
+    return token
+
+
+def build_cache_once(k_parts, v_parts):
+    # one-time assembly OUTSIDE any decode loop: not a per-token rebuild
+    k_cache = jnp.concatenate(k_parts, axis=0)
+    v_cache = jnp.concatenate(v_parts, axis=0)
+    return k_cache, v_cache
+
+
+def collect_tokens(decode_step, token, state):
+    out = token
+    for _ in range(4):
+        token, state = decode_step(token, state)
+        # concatenating the OUTPUT stream is fine — it is not KV state
+        out = jnp.concatenate([out, token])
+    return out
+
+
+def batch_loop(ids_batches, score_fn, cache_misses):
+    # cache-NAMED values concatenated in a loop with no decode dispatch:
+    # a metrics loop, not a decode loop
+    for ids in ids_batches:
+        cache_misses = jnp.append(cache_misses, score_fn(ids))
+    return cache_misses

@@ -1,0 +1,26 @@
+"""R16 positives: decode loops that rebuild the KV cache per token."""
+import jax  # noqa: F401
+import jax.numpy as jnp
+
+
+def greedy_decode(params, decode_step, token, k_cache, v_cache):
+    for _ in range(32):
+        logits, k_new, v_new = decode_step(params, token, k_cache, v_cache)
+        k_cache = jnp.concatenate([k_cache, k_new], axis=2)
+        v_cache = jnp.concatenate([v_cache, v_new], axis=2)
+        token = logits.argmax(-1)
+    return token
+
+
+def grow_past(step, x, past_kv):
+    while x.size:
+        x, kv = step(x, past_kv)
+        past_kv = jnp.append(past_kv, kv)
+    return past_kv
+
+
+def stacked_rebuild(generate_one, layers_kv, tok):
+    for _ in range(8):
+        tok, new = generate_one(tok, layers_kv)
+        layers_kv = jnp.stack([layers_kv, new])
+    return layers_kv

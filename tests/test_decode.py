@@ -1,0 +1,518 @@
+"""Generative decoding tests: the bitwise incremental-vs-recompute
+contract, slot reuse under continuous batching, int8 KV parity, the
+zero-retrace guarantee, KV budgets, the streaming hop-chain contract, and
+chain integrity through a mid-decode replica kill.
+
+The bitwise gate compares incremental decode against a FULL RECOMPUTE
+from a cold cache in the same slot geometry — every cached value
+recomputed from scratch, nothing reused — which is exactly the property
+the KV cache + slot machinery claims (slot aliasing, stale-KV leaks,
+donation bugs and wrong masks all break it).  Against the one-shot WIDE
+causal forward the comparison is argmax-exact within 5e-6: XLA's CPU gemm
+blocks the contraction differently per row extent (measured in
+``models/decoder.py``'s docstring), so a ``[rows, 1]`` pass and a
+``[rows, S]`` pass agree to accumulation order, not bits, on this
+backend."""
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
+from pdnlp_tpu.models import bert, decoder, get_config
+from pdnlp_tpu.obs.memory import KVBudget, KVBudgetExceeded
+from pdnlp_tpu.obs.request import chain_issues, validate_chains
+from pdnlp_tpu.ops.attention import causal_bias, dot_product_attention
+from pdnlp_tpu.serve import DecodeBatcher, DecodeEngine, DecodeRouter
+from pdnlp_tpu.serve.decode import detokenize
+from pdnlp_tpu.utils.config import Args
+
+TEXTS = ["天地人你我", "好坏大小上下来去" * 5, "爱恨喜怒哀乐" * 15]
+BUCKETS = (16, 32)
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return WordPieceTokenizer(build_vocab(TEXTS, size=128))
+
+
+def make_args(**kw):
+    base = dict(model="bert-tiny", decode_slots=4, decode_max_len=48,
+                max_new_tokens=8)
+    base.update(kw)
+    return Args(**base)
+
+
+def prompts(n=6, seed=3, lo=4, hi=14, vocab=120):
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(lo, hi, n)
+    return [rng.integers(5, vocab, int(k)).tolist() for k in lens]
+
+
+def run_streams(batcher, ps, max_new=8, eos=-1, timeout=120):
+    batcher.eos_id = eos  # -1 = never stop early (deterministic lengths)
+    streams = [batcher.submit_ids(p, max_new_tokens=max_new) for p in ps]
+    return streams, [s.result(timeout=timeout) for s in streams]
+
+
+# --------------------------------------------------------- model-level math
+
+def test_causal_attention_composition():
+    cb = np.asarray(causal_bias(8))
+    assert cb.shape == (1, 1, 8, 8)
+    assert (cb[0, 0][np.tril_indices(8)] == 0).all()
+    assert (cb[0, 0][np.triu_indices(8, 1)] < -1e8).all()
+    q = jnp.ones((2, 4, 2, 8))
+    k = jnp.ones((2, 6, 2, 8))
+    with pytest.raises(ValueError):  # causal needs a square mask
+        dot_product_attention(q, k, k, causal=True)
+
+
+def test_decode_step_bitwise_equals_full_recompute(tok):
+    """THE decode-correctness pin: incremental KV decode (a live cache
+    carried across steps) is bitwise equal, per step, to a full recompute
+    from a COLD cache — fresh prefill + from-scratch replay of every
+    generated token, nothing reused."""
+    cfg = get_config("bert-tiny", vocab_size=tok.vocab_size, num_labels=6)
+    params = bert.init_params(jax.random.key(0), cfg)
+    head = decoder.init_lm_head(jax.random.key(1), cfg)
+    L, N, D = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    B, W, bucket, steps = 3, 32, 16, 5
+    ps = prompts(3, seed=7, hi=10, vocab=tok.vocab_size)
+    pf = jax.jit(decoder.prefill, static_argnums=(2,))
+    step = jax.jit(decoder.decode_step, static_argnums=(2,))
+
+    def run_chain():
+        """prefill once, then decode `steps` tokens greedily, returning
+        the per-step logits — the scratch replay recomputes the whole
+        chain cold and must reproduce it bit for bit."""
+        ids = np.zeros((B, bucket), np.int32)
+        mask = np.zeros((B, bucket), np.int32)
+        for i, p in enumerate(ps):
+            ids[i, :len(p)] = p
+            mask[i, :len(p)] = 1
+        last = np.asarray([len(p) - 1 for p in ps], np.int32)
+        lg, ks, vs = pf(params, head, cfg, ids, mask, last)
+        ck = jnp.zeros((L, B, W, N, D), jnp.float32).at[:, :, :bucket].set(ks)
+        cv = jnp.zeros((L, B, W, N, D), jnp.float32).at[:, :, :bucket].set(vs)
+        out = [np.asarray(lg)]
+        cur = np.argmax(out[0], -1).astype(np.int32)
+        pos = last + 1
+        for _ in range(steps):
+            lg, ck, cv = step(params, head, cfg, cur[:, None], ck, cv, pos)
+            out.append(np.asarray(lg))
+            cur = np.argmax(out[-1], -1).astype(np.int32)
+            pos = pos + 1
+        return out
+
+    a = run_chain()
+    b = run_chain()  # cold cache, every K/V recomputed
+    for t, (x, y) in enumerate(zip(a, b)):
+        assert np.array_equal(x, y), f"step {t} not bitwise"
+
+
+def test_decode_matches_wide_forward_oracle(tok):
+    """Incremental decode vs the INDEPENDENT one-shot wide causal
+    forward: greedy argmax equal at every step, logits within 5e-6
+    (the documented extent-blocking ULP bound; observed ~3e-7)."""
+    cfg = get_config("bert-tiny", vocab_size=tok.vocab_size, num_labels=6)
+    params = bert.init_params(jax.random.key(0), cfg)
+    head = decoder.init_lm_head(jax.random.key(1), cfg)
+    L, N, D = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    B, W, bucket = 3, 32, 16
+    ps = prompts(3, seed=9, hi=10, vocab=tok.vocab_size)
+    pf = jax.jit(decoder.prefill, static_argnums=(2,))
+    step = jax.jit(decoder.decode_step, static_argnums=(2,))
+
+    ids = np.zeros((B, bucket), np.int32)
+    mask = np.zeros((B, bucket), np.int32)
+    for i, p in enumerate(ps):
+        ids[i, :len(p)] = p
+        mask[i, :len(p)] = 1
+    last = np.asarray([len(p) - 1 for p in ps], np.int32)
+    lg, ks, vs = pf(params, head, cfg, ids, mask, last)
+    ck = jnp.zeros((L, B, W, N, D), jnp.float32).at[:, :, :bucket].set(ks)
+    cv = jnp.zeros((L, B, W, N, D), jnp.float32).at[:, :, :bucket].set(vs)
+    gen = [[] for _ in range(B)]
+    cur = np.argmax(np.asarray(lg), -1).astype(np.int32)
+    pos = last + 1
+    for t in range(5):
+        lg, ck, cv = step(params, head, cfg, cur[:, None], ck, cv, pos)
+        oid = np.zeros((B, W), np.int32)
+        om = np.zeros((B, W), np.int32)
+        for i, p in enumerate(ps):
+            seq = p + gen[i] + [int(cur[i])]
+            oid[i, :len(seq)] = seq
+            om[i, :len(seq)] = 1
+        olg, _, _ = pf(params, head, cfg, oid, om, pos)
+        got, want = np.asarray(lg), np.asarray(olg)
+        assert np.abs(got - want).max() < 5e-6, f"step {t}"
+        assert (np.argmax(got, -1) == np.argmax(want, -1)).all(), f"step {t}"
+        for i in range(B):
+            gen[i].append(int(cur[i]))
+        cur = np.argmax(got, -1).astype(np.int32)
+        pos = pos + 1
+
+
+def test_engine_slot_reuse_is_bitwise_clean(tok):
+    """A stream decoded in a REUSED slot (stale K/V from a previous
+    occupant beyond its positions) is bitwise identical to the same
+    stream on a fresh engine — the visibility mask proves stale cache
+    contents contribute exact zeros."""
+    args = make_args()
+    p = prompts(1, seed=11, vocab=tok.vocab_size)[0]
+
+    def drive(engine, warm_garbage):
+        slot = 2
+        if warm_garbage:  # a previous occupant fills slot 2 end to end
+            g = list(range(5, 15))
+            engine.prefill_ids([g], [slot])
+            t = np.zeros((engine.slots,), np.int32)
+            po = np.zeros((engine.slots,), np.int32)
+            po[slot] = len(g)
+            for k in range(engine.max_len - len(g)):
+                lg = engine.decode_batch(t, po, live=1)
+                t[slot] = int(np.argmax(lg[slot]))
+                po[slot] += 1
+        logits0 = engine.prefill_ids([p], [slot])
+        out = [logits0[0]]
+        t = np.zeros((engine.slots,), np.int32)
+        po = np.zeros((engine.slots,), np.int32)
+        t[slot] = int(np.argmax(logits0[0]))
+        po[slot] = len(p)
+        for _ in range(6):
+            lg = engine.decode_batch(t, po, live=1)
+            out.append(lg[slot])
+            t[slot] = int(np.argmax(lg[slot]))
+            po[slot] += 1
+        return out
+
+    a = drive(DecodeEngine(args, tokenizer=tok, mesh=None,
+                           buckets=BUCKETS), warm_garbage=True)
+    b = drive(DecodeEngine(args, tokenizer=tok, mesh=None,
+                           buckets=BUCKETS), warm_garbage=False)
+    for t, (x, y) in enumerate(zip(a, b)):
+        assert np.array_equal(x, y), f"step {t}: stale slot leaked"
+
+
+# ------------------------------------------------------- continuous batching
+
+def test_continuous_batching_slot_join_leave(tok):
+    """More streams than slots: finished streams leave, waiting streams
+    claim freed slots between steps, every stream completes, and the
+    freed-slot reuse + occupancy metrics actually record it."""
+    eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
+                       buckets=BUCKETS)
+    b = DecodeBatcher(eng).start()
+    b.warmup()
+    ps = prompts(10, seed=5, vocab=tok.vocab_size)
+    _, outs = run_streams(b, ps, max_new=6)
+    assert all(len(o) == 6 for o in outs)
+    snap = b.snapshot()
+    assert snap["decode"]["tokens_out_total"] == 60
+    assert snap["replica"]["slot_reuse_ms"]["count"] >= 4
+    assert snap["replica"]["slot_occupancy"]["count"] >= 1
+    assert snap["decode"]["streams_total"] == 10
+    b.stop()
+
+
+def test_batcher_tokens_deterministic_across_claim_orders(tok):
+    """The same prompt generates the same tokens whatever else shares
+    the decode batch and in whatever order slots were claimed."""
+    ps = prompts(5, seed=13, vocab=tok.vocab_size)
+
+    def run(order):
+        eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
+                           buckets=BUCKETS)
+        b = DecodeBatcher(eng).start()
+        b.warmup()
+        b.eos_id = -1
+        streams = {i: b.submit_ids(ps[i], max_new_tokens=6) for i in order}
+        res = {i: s.result(timeout=60) for i, s in streams.items()}
+        b.stop()
+        return res
+
+    a, z = run([0, 1, 2, 3, 4]), run([4, 2, 0, 3, 1])
+    assert all(a[i] == z[i] for i in range(5))
+
+
+def test_streaming_surface_and_detokenize(tok):
+    eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
+                       buckets=BUCKETS)
+    b = DecodeBatcher(eng).start()
+    b.warmup()
+    b.eos_id = -1
+    s = b.submit_ids([5, 6, 7], max_new_tokens=4)
+    streamed = list(s.tokens(timeout=30))
+    assert streamed == s.result(1)
+    assert len(streamed) == 4
+    text = detokenize(tok, streamed)
+    assert isinstance(text, str) and text
+    b.stop()
+
+
+def test_zero_retraces_50_mixed_streams(tok):
+    """The acceptance bar: across 50 mixed-length streams, neither the
+    bucketed prefill nor the ONE fixed decode shape compiles after
+    warmup (retrace counter AND compile-cache misses stay flat)."""
+    eng = DecodeEngine(make_args(decode_slots=8, decode_max_len=64,
+                                 max_new_tokens=12),
+                       tokenizer=tok, mesh=None, buckets=BUCKETS)
+    b = DecodeBatcher(eng).start()
+    b.warmup()
+    retr0 = eng.metrics.retraces.value
+    miss0 = eng.metrics.cache_misses.value
+    ps = prompts(50, seed=17, lo=3, hi=30, vocab=tok.vocab_size)
+    _, outs = run_streams(b, ps, max_new=8)
+    assert all(len(o) == 8 for o in outs)
+    assert eng.metrics.retraces.value - retr0 == 0
+    assert eng.metrics.cache_misses.value - miss0 == 0
+    b.stop()
+
+
+# ------------------------------------------------------------------ int8 KV
+
+def test_kv_int8_argmax_parity(tok):
+    """int8 KV (calibrated per-channel scale tables) greedy-decodes the
+    same token sequences as the fp32 cache."""
+    ps = prompts(4, seed=1, vocab=tok.vocab_size)
+
+    def gen(**kw):
+        eng = DecodeEngine(make_args(**kw), tokenizer=tok, mesh=None,
+                           buckets=BUCKETS)
+        b = DecodeBatcher(eng).start()
+        b.warmup()
+        _, outs = run_streams(b, ps, max_new=8)
+        b.stop()
+        return outs
+
+    assert gen() == gen(kv_dtype="int8")
+
+
+def test_kv_scales_offline_artifact_matches_self_calibration(tok, tmp_path):
+    """`quantize_ckpt.py --kv_calib` emits byte-identical scale tables to
+    engine self-calibration for the same params, and the engine auto-loads
+    the manifest-verified sidecar on checkpoint swap."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    from quantize_ckpt import main as quantize_main
+
+    from pdnlp_tpu.train import checkpoint as ckpt
+
+    cfg = get_config("bert-tiny", vocab_size=tok.vocab_size, num_labels=6)
+    params = bert.init_params(jax.random.key(42), cfg)
+    path = str(tmp_path / "gen-cls.msgpack")
+    ckpt.save(path, params)
+    assert quantize_main([path, "--kv_calib", "bert-tiny",
+                          "-o", str(tmp_path / "gen.int8.msgpack")]) == 0
+    sidecar = str(tmp_path / "gen-cls.kvscales.msgpack")
+    assert os.path.exists(sidecar)
+    assert os.path.exists(sidecar + ".manifest.json")
+
+    eng = DecodeEngine(make_args(kv_dtype="int8"), tokenizer=tok,
+                       mesh=None, buckets=BUCKETS)
+    eng.load_checkpoint(path)          # auto-loads the sidecar
+    loaded_k = np.asarray(eng._kv_scales[0])
+    eng2 = DecodeEngine(make_args(kv_dtype="int8"), tokenizer=tok,
+                        mesh=None, buckets=BUCKETS)
+    eng2.load_checkpoint(path)
+    eng2._kv_scales = None             # force self-calibration instead
+    eng2.calibrate_kv()
+    np.testing.assert_array_equal(loaded_k, np.asarray(eng2._kv_scales[0]))
+
+
+# ---------------------------------------------------------------- KV budget
+
+def test_kv_budget_doors(tok):
+    args = make_args()
+    eng = DecodeEngine(args, tokenizer=tok, mesh=None, buckets=BUCKETS)
+    slot_mb = decoder.kv_cache_bytes(eng.cfg, 1, args.decode_max_len,
+                                     np.float32) / 2**20
+    # (a) construction refusal: not even one slot fits
+    with pytest.raises(KVBudgetExceeded):
+        DecodeEngine(make_args(kv_hbm_mb=slot_mb / 2), tokenizer=tok,
+                     mesh=None, buckets=BUCKETS)
+    # (b) loud slot cap: budget covers 2 of the 4 requested slots
+    capped = DecodeEngine(make_args(kv_hbm_mb=2.2 * slot_mb),
+                          tokenizer=tok, mesh=None, buckets=BUCKETS)
+    assert capped.slots == 2
+    assert capped.kv_snapshot()["budget_mb"] == pytest.approx(
+        2.2 * slot_mb, abs=1e-3)
+    # (c) admission refusal in budget units: a stream that cannot fit
+    b = DecodeBatcher(capped).start()
+    with pytest.raises(KVBudgetExceeded):
+        b.submit_ids(list(range(5, 15)), max_new_tokens=10_000)
+    # (d) live occupancy gauge moves while streams decode (and returns
+    # to zero when the slot frees)
+    b.warmup()
+    b.eos_id = -1
+    s = b.submit_ids(list(range(5, 12)), max_new_tokens=30)
+    peak = 0
+    deadline = time.monotonic() + 30
+    while not s.done() and time.monotonic() < deadline:
+        peak = max(peak, b.metrics.kv_bytes_live.value)
+        time.sleep(0.001)
+    s.result(timeout=60)
+    assert peak > 0
+    assert b.metrics.kv_bytes_live.value == 0
+    b.stop()
+
+
+def test_kv_budget_unbudgeted_plain_capacity_error(tok):
+    eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
+                       buckets=BUCKETS)
+    b = DecodeBatcher(eng).start()
+    with pytest.raises(ValueError):
+        b.submit_ids(list(range(5, 15)), max_new_tokens=10_000)
+    b.stop()
+
+
+def test_kv_budget_pure_policy():
+    bgt = KVBudget(1.0)  # 1 MB
+    assert bgt.cap_slots(8, 2**19) == 2          # two 0.5 MB slots fit
+    with pytest.raises(KVBudgetExceeded):
+        bgt.cap_slots(8, 2**21)                  # a 2 MB slot never fits
+    with pytest.raises(KVBudgetExceeded):
+        bgt.check_stream(tokens_total=2048, token_bytes=1024)
+    bgt.set_live(4096)
+    assert bgt.snapshot()["live_bytes"] == 4096
+    assert KVBudget(0).cap_slots(8, 2**40) == 8  # unbudgeted: no checks
+
+
+# ------------------------------------------------------------------ infill
+
+def test_infill_scoring_matches_bidirectional_mlm(tok):
+    """The MLM-infilling scorer is exactly the bidirectional trunk + LM
+    head — pinned bitwise against the direct model-level computation at
+    the same padded shapes."""
+    eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
+                       buckets=BUCKETS)
+    ids = [5, 6, tok.unk_id, 8, 9]
+    got = eng.infill_ids([ids])
+    rows, bucket = eng.prefill_rows, 16
+    pad_ids = np.zeros((rows, bucket), np.int32)
+    pad_mask = np.zeros((rows, bucket), np.int32)
+    pad_ids[0, :len(ids)] = ids
+    pad_mask[0, :len(ids)] = 1
+    want = decoder.infill_logits(eng.params, eng.head, eng.cfg,
+                                 jnp.asarray(pad_ids),
+                                 jnp.asarray(pad_mask))
+    np.testing.assert_array_equal(got[0], np.asarray(want)[0])
+
+
+# -------------------------------------------------------------- hop chains
+
+def _hop(name, t, **attrs):
+    return {"name": "hop", "t0": t, "t1": t, "attrs": attrs}
+
+
+def test_streaming_chain_rules():
+    ok = [_hop("hop", 0.0, request_id="r1", hop="admit"),
+          _hop("hop", 1.0, request_id="r1", hop="prefill", slot=0),
+          _hop("hop", 2.0, request_id="r1", hop="decode", slot=0, step=0),
+          _hop("hop", 3.0, request_id="r1", hop="complete")]
+    assert chain_issues(ok) == []
+    # prefill-less decode is a violation
+    bad = [ok[0], ok[2], ok[3]]
+    assert any("no earlier 'prefill'" in i for i in chain_issues(bad))
+    # a requeue + re-prefill continuation is legal
+    requeued = ok[:3] + [
+        _hop("hop", 4.0, request_id="r1", hop="requeue", streamed=True),
+        _hop("hop", 5.0, request_id="r1", hop="prefill", slot=1),
+        _hop("hop", 6.0, request_id="r1", hop="decode", slot=1, step=1),
+        _hop("hop", 7.0, request_id="r1", hop="complete")]
+    assert chain_issues(requeued) == []
+    # zero-decode streams (EOS at prefill) are complete
+    assert chain_issues([ok[0], ok[1], ok[3]]) == []
+
+
+def test_decode_hops_carry_slot_step_tokens(tok):
+    args = make_args(trace=True)
+    eng = DecodeEngine(args, tokenizer=tok, mesh=None, buckets=BUCKETS)
+    assert eng.tracer.enabled
+    b = DecodeBatcher(eng).start()
+    b.warmup()
+    b.eos_id = -1
+    s = b.submit_ids([5, 6, 7, 8], max_new_tokens=4)
+    s.result(timeout=60)
+    b.stop()
+    hops = [r["attrs"] for r in eng.tracer.records()
+            if r.get("name") == "hop"
+            and (r.get("attrs") or {}).get("request_id") == s.rid]
+    kinds = [h["hop"] for h in hops]
+    assert kinds[0] == "admit" and kinds[-1] == "complete"
+    assert "prefill" in kinds
+    decodes = [h for h in hops if h["hop"] == "decode"]
+    assert decodes and all(
+        "slot" in d and "step" in d and "tokens_out" in d for d in decodes)
+    # step = the index of the token each decode step produces; token 0
+    # came from prefill, so decode steps run 1..max_new-1
+    assert [d["step"] for d in decodes] == list(range(1, len(decodes) + 1))
+    assert [d["tokens_out"] for d in decodes] == \
+        list(range(2, len(decodes) + 2))
+    report = validate_chains(eng.tracer.records(), [s.rid])
+    assert report["complete"] == 1 and report["streamed"] == 1
+
+
+# ------------------------------------------------------------ replica kill
+
+def test_mid_decode_replica_kill_no_dup_no_loss(tok):
+    """Chain integrity through a mid-decode replica kill: orphan streams
+    re-prefill on the survivor and emit EXACTLY the reference token
+    sequences — no duplicated, no lost tokens — with every chain complete
+    (admit → prefill → decode* → requeue → prefill → ... → complete)."""
+    args = make_args(decode_slots=4, decode_max_len=120,
+                     max_new_tokens=64, trace=True)
+    ps = prompts(30, seed=3, lo=3, hi=14, vocab=tok.vocab_size)
+
+    ref_eng = DecodeEngine(args, tokenizer=tok, mesh=None, buckets=BUCKETS)
+    rb = DecodeBatcher(ref_eng).start()
+    rb.warmup()
+    _, refs = run_streams(rb, ps, max_new=48)
+    rb.stop()
+
+    engines = [DecodeEngine(args, tokenizer=tok, mesh=None,
+                            buckets=BUCKETS) for _ in range(2)]
+    tracer = engines[0].tracer
+    for e in engines[1:]:
+        e.tracer = tracer
+    router = DecodeRouter(engines).start()
+    for b in router.batchers:
+        b.eos_id = -1
+    router.warmup()
+    streams = [router.submit_ids(p, max_new_tokens=48) for p in ps]
+    deadline = time.monotonic() + 60
+    while (router.batchers[0].metrics.tokens_out_total.value < 100
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    router.kill(0)
+    outs = [s.result(timeout=180) for s in streams]
+    router.stop()
+
+    assert router.batchers[0].dead and not router.batchers[1].dead
+    assert outs == refs, "kill recovery duplicated or lost tokens"
+    report = validate_chains(tracer.records(), [s.rid for s in streams])
+    assert report["incomplete"] == {}
+    assert report["complete"] == len(streams)
+    assert report["requeued"] >= 1
+    assert router.batchers[1].rmetrics.requeued_in.value >= 1
+
+
+def test_router_all_replicas_dead_fails_loudly(tok):
+    eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
+                       buckets=BUCKETS)
+    router = DecodeRouter([eng]).start()
+    router.warmup()
+    router.kill(0)
+    deadline = time.monotonic() + 10
+    while not router.batchers[0].dead and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(RuntimeError):
+        router.submit_ids([5, 6, 7])
+    router.stop()

@@ -362,6 +362,42 @@ def test_r15_hint_names_the_choke_point():
     assert "_actuate" in f.hint and "canary_fraction" in f.hint
 
 
+def test_r16_kv_realloc_positive():
+    # per-token cache concatenate rebuilds (9, 10), append-grown past
+    # (18), stack rebuild (25) — each in a loop dispatching a
+    # decode/generate-shaped call
+    assert all_hits("r16_pos.py") == [("R16", 9), ("R16", 10),
+                                      ("R16", 18), ("R16", 25)]
+
+
+def test_r16_kv_realloc_negative():
+    # .at[].set / dynamic_update_slice (the fix), one-time assembly
+    # outside decode loops, non-cache concatenation in a decode loop,
+    # and cache-NAMED appends in a non-decode loop all stay clean
+    assert hits("r16_neg.py", "R16") == []
+
+
+def test_r16_requires_decode_dispatch(tmp_path):
+    """A cache concatenate in a plain data loop is not a decode-loop
+    rebuild — the loop must dispatch a decode/step-shaped call."""
+    p = tmp_path / "plain.py"
+    p.write_text("import jax.numpy as jnp\n"
+                 "def gather(batches, kv_cache):\n"
+                 "    for b in batches:\n"
+                 "        kv_cache = jnp.concatenate([kv_cache, b])\n"
+                 "    return kv_cache\n")
+    assert [f for f in analyze_paths([str(p)], root=str(tmp_path))
+            if f.rule_id == "R16"] == []
+
+
+def test_r16_hint_names_the_fix():
+    path = os.path.join(FIXTURES, "r16_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R16"][0]
+    assert "donate" in f.hint.lower()
+    assert "dynamic_update_slice" in f.hint
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -371,10 +407,10 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    # the registry sorts by id STRING (R10..R15 between R1 and R2)
+    # the registry sorts by id STRING (R10..R16 between R1 and R2)
     assert list(all_rules()) == ["R1", "R10", "R11", "R12", "R13", "R14",
-                                 "R15", "R2", "R3", "R4", "R5", "R6",
-                                 "R7", "R8", "R9"]
+                                 "R15", "R16", "R2", "R3", "R4", "R5",
+                                 "R6", "R7", "R8", "R9"]
 
 
 # -------------------------------------------------------------- suppressions
