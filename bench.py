@@ -3882,8 +3882,37 @@ def resilience_smoke(argv) -> None:
         sys.exit("resilience smoke FAILED: " + "; ".join(violations))
 
 
+def _lint_gate() -> None:
+    """Refuse to burn accelerator time on a tree that fails the jaxlint
+    gate (tracing + concurrency suites vs the committed baseline) — the
+    same shape as the leaked-PDNLP_GELU_TANH refusal: a smoke number
+    measured on a tree carrying NEW hazards is unreproducible evidence.
+    Pure-ast, no jax import: the check costs ~2s against smokes that run
+    for minutes."""
+    from pdnlp_tpu.analysis import analyze_paths, baseline, default_paths
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    base_path = os.path.join(repo, baseline.DEFAULT_BASELINE)
+    if not os.path.exists(base_path):
+        return  # no ratchet recorded: nothing to enforce against
+    findings = analyze_paths(default_paths(repo), root=repo)
+    new, _fixed = baseline.compare(findings, baseline.load(base_path))
+    if new:
+        lines = "\n".join(f"  {f.path}:{f.line}: {f.rule_id} {f.message}"
+                          for f in new[:20])
+        more = "" if len(new) <= 20 else f"\n  ... and {len(new) - 20} more"
+        sys.exit(
+            "bench.py: jaxlint gate FAILED — this tree carries NEW "
+            "tracing/concurrency violations vs results/"
+            "jaxlint_baseline.json:\n" + lines + more + "\n"
+            "Fix them (or suppress with a reasoned `# jaxlint: disable=`) "
+            "and re-run scripts/lint_gate.sh before benching.")
+
+
 def main() -> None:
     argv = sys.argv[1:]
+    if not any(a in ("--help", "-h") for a in argv):
+        _lint_gate()  # usage lookups stay free; every real run is gated
     if "--resilience" in argv:
         # resilience smoke intercept (async-save pause A/B + kill
         # injection, results/resilience_smoke.json) — like --kernels, not

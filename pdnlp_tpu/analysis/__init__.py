@@ -22,9 +22,18 @@ R7   device-put-in-step-loop        per-step host->device upload inside a
                                     resident/prefetch modes eliminate)
 ===  =============================  ==========================================
 
-CLI: ``python lint_tpu.py`` (or ``python -m pdnlp_tpu.analysis``); library:
-:func:`analyze_paths`.  Inline suppressions: ``# jaxlint: disable=R1[,R2]``.
-The committed ``results/jaxlint_baseline.json`` ratchets tier-1 via
+(R8-R16 extend the tracing suite to the serve/obs surfaces — see
+README.md's rule table.)  The ``concurrency`` suite (T1-T3, *threadlint*)
+is whole-program: guard inference / unguarded shared attributes,
+lock-order cycles, and blocking calls under a lock — over a module graph
+with alias-resolved call edges and class-level attribute type models
+(:class:`~pdnlp_tpu.analysis.core.ProgramInfo`).
+
+CLI: ``python lint_tpu.py`` (or ``python -m pdnlp_tpu.analysis``) with
+``--suite {tracing,concurrency,all}`` and ``--format {text,json,sarif}``;
+library: :func:`analyze_paths`.  Inline suppressions:
+``# jaxlint: disable=R1[,T1]``.  The committed
+``results/jaxlint_baseline.json`` ratchets tier-1 via
 ``tests/test_jaxlint.py``: only NEW violations fail.
 """
 from pdnlp_tpu.analysis.core import (  # noqa: F401

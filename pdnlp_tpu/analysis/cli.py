@@ -14,10 +14,12 @@ from typing import List, Optional
 
 from pdnlp_tpu.analysis import baseline as baseline_mod
 from pdnlp_tpu.analysis.core import (
-    Finding, all_rules, parse_module, run_rules,
+    Finding, ProgramInfo, ProgramRule, all_rules, parse_module,
+    run_program_rules, run_rules, select_rules,
 )
 from pdnlp_tpu.analysis.reporters import (
-    render_json, render_rule_table, render_summary, render_text,
+    render_json, render_rule_table, render_sarif, render_summary,
+    render_text,
 )
 
 #: dirs never descended into when a directory path is scanned
@@ -66,18 +68,27 @@ def display_path(path: str, root: str) -> str:
 
 
 def analyze_paths(paths: List[str], root: str = ".",
-                  rule_ids: Optional[List[str]] = None
-                  ) -> List[Finding]:
+                  rule_ids: Optional[List[str]] = None,
+                  suite: str = "all") -> List[Finding]:
     """Library entrypoint (the pytest ratchet calls this): all findings
-    over ``paths``, display paths relative to ``root``."""
+    over ``paths``, display paths relative to ``root``.  Per-file tracing
+    rules run module by module; the concurrency suite runs once over the
+    whole-program :class:`ProgramInfo` built from the same file set."""
     findings: List[Finding] = []
+    modules = []
     for path in collect_files(paths):
         mod = parse_module(path, display_path(path, root))
         if mod is None:
             print(f"jaxlint: syntax error in {path}, skipped",
                   file=sys.stderr)
             continue
-        findings += run_rules(mod, rule_ids)
+        modules.append(mod)
+        findings += run_rules(mod, rule_ids, suite=suite)
+    wants_program = any(isinstance(r, ProgramRule)
+                        for r in select_rules(rule_ids, suite).values())
+    if modules and wants_program:
+        findings += run_program_rules(ProgramInfo(modules), rule_ids,
+                                      suite=suite)
     return sorted(findings, key=Finding.sort_key)
 
 
@@ -89,8 +100,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="*",
                    help="files/dirs to scan (default: the repo's standard "
                         "hazard surface)")
+    p.add_argument("--suite", choices=("tracing", "concurrency", "all"),
+                   default="all",
+                   help="rule suite: the per-file tracing rules (R*), the "
+                        "whole-program concurrency analyses (T*), or both "
+                        "(default: %(default)s)")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default=None,
+                   help="report format (default: text; sarif emits SARIF "
+                        "2.1.0 for CI/editor ingestion)")
     p.add_argument("--json", action="store_true",
-                   help="machine-readable JSON report on stdout")
+                   help="machine-readable JSON report on stdout "
+                        "(alias for --format json)")
     p.add_argument("--fix-hints", action="store_true",
                    help="print the suggested rewrite under each finding")
     p.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE,
@@ -124,14 +145,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f" (known: {', '.join(all_rules())})", file=sys.stderr)
             return 2
 
+    fmt = args.format or ("json" if args.json else "text")
     paths = args.paths or default_paths()
     try:
-        findings = analyze_paths(paths, root=".", rule_ids=rule_ids)
+        findings = analyze_paths(paths, root=".", rule_ids=rule_ids,
+                                 suite=args.suite)
     except FileNotFoundError as e:
         print(f"jaxlint: no such path: {e}", file=sys.stderr)
         return 2
 
     if args.write_baseline:
+        if args.suite != "all" or rule_ids:
+            # a partial scan must never become THE baseline: it would
+            # silently drop every other suite's grandfathered findings
+            # and the next full run would re-blame them all as new
+            print("jaxlint: refusing --write-baseline with --suite/"
+                  "--rules filters — the baseline records the FULL "
+                  "surface (run without filters)", file=sys.stderr)
+            return 2
         baseline_mod.write(findings, args.baseline)
         print(f"jaxlint: wrote {len(findings)} finding(s) to {args.baseline}")
         return 0
@@ -140,11 +171,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     new, fixed = list(findings), 0
     if not args.no_baseline and os.path.exists(args.baseline):
         baseline_used = True
-        new, fixed = baseline_mod.compare(findings,
-                                          baseline_mod.load(args.baseline))
+        # compare within the scanned scope only: under --suite/--rules a
+        # baseline entry for an unscanned rule is out of scope, not fixed
+        in_scope = set(select_rules(rule_ids, args.suite))
+        entries = [e for e in baseline_mod.load(args.baseline)
+                   if e["rule"] in in_scope]
+        new, fixed = baseline_mod.compare(findings, entries)
 
-    if args.json:
+    if fmt == "json":
         print(render_json(findings, new, fixed, baseline_used))
+    elif fmt == "sarif":
+        print(render_sarif(findings, new, baseline_used))
     else:
         shown = findings if (args.no_baseline or not baseline_used) else new
         if shown:

@@ -422,6 +422,234 @@ def parse_module(path: str, display_path: str) -> Optional[ModuleInfo]:
     return ModuleInfo(display_path, source, tree)
 
 
+# ------------------------------------------------- interprocedural program
+
+class ClassModel:
+    """One class as the whole-program analyses see it: its methods, the
+    inferred types of its ``self.<attr>`` attributes, and the qualified
+    name cross-module call edges resolve against."""
+
+    def __init__(self, mod: ModuleInfo, node: ast.ClassDef,
+                 qualname: str):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.qualname = qualname
+        self.methods: Dict[str, ast.AST] = {}
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[stmt.name] = stmt
+        #: ``self.<attr>`` -> qualified class name, where inferable from
+        #: ``self.x = ClassName(...)`` (or a typed local / helper return)
+        self.attr_types: Dict[str, str] = {}
+        #: method name -> qualified class name its return value carries
+        self.return_types: Dict[str, str] = {}
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<ClassModel {self.qualname}>"
+
+
+def module_dotted_name(display_path: str) -> Optional[str]:
+    """``pdnlp_tpu/serve/router.py`` -> ``pdnlp_tpu.serve.router``; None
+    for paths that are not importable module names (``multi-tpu-*.py``)."""
+    if not display_path.endswith(".py"):
+        return None
+    parts = display_path[:-3].split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    if not parts or not all(p.isidentifier() for p in parts):
+        return None
+    return ".".join(parts)
+
+
+#: external classes the type inference tracks by name (never scanned, but
+#: knowing "this attribute is a Thread / Queue / Event" is what lets the
+#: concurrency rules judge ``.join()``/``.get()``/``.wait()`` receivers)
+KNOWN_EXTERNAL_TYPES = {
+    "threading.Thread", "threading.Timer", "threading.Event",
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "socket.socket",
+    "concurrent.futures.ThreadPoolExecutor",
+}
+
+
+class ProgramInfo:
+    """The whole-program view the concurrency suite runs over: every
+    scanned :class:`ModuleInfo`, a class registry keyed by qualified name
+    (resolved through each module's import-alias map), a module-level
+    function registry for cross-module call edges, and class-level
+    attribute type models so ``rep.hb.beat(...)`` resolves to
+    ``Heartbeat.beat`` even across modules.
+
+    Construction is two type-inference passes over every function body:
+    pass 1 records ``self.x = ClassName(...)`` attribute types and
+    builder-method return types; pass 2 re-runs with those models
+    available so locals assigned from attributes/builders (and attribute
+    writes THROUGH such locals, ``rep.hb = Heartbeat(...)``) resolve too.
+    """
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules: Dict[str, ModuleInfo] = {m.path: m for m in modules}
+        self.classes: Dict[str, ClassModel] = {}          # by qualname
+        self._by_simple: Dict[str, List[ClassModel]] = {}  # by class name
+        self._by_module: Dict[str, Dict[str, ClassModel]] = {}
+        #: module-level functions: qualified name -> (ModuleInfo, def node)
+        self.functions: Dict[str, Tuple[ModuleInfo, ast.AST]] = {}
+        self._funcs_by_module: Dict[str, Dict[str, Tuple[ModuleInfo, ast.AST]]] = {}
+        for mod in modules:
+            mod_name = module_dotted_name(mod.path)
+            local: Dict[str, ClassModel] = {}
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                qual = (f"{mod_name}.{node.name}" if mod_name
+                        else f"{mod.path}::{node.name}")
+                cm = ClassModel(mod, node, qual)
+                self.classes[qual] = cm
+                self._by_simple.setdefault(node.name, []).append(cm)
+                local[node.name] = cm
+            self._by_module[mod.path] = local
+            flocal: Dict[str, Tuple[ModuleInfo, ast.AST]] = {}
+            for node in mod.tree.body:  # top-level defs only
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fqual = (f"{mod_name}.{node.name}" if mod_name
+                             else f"{mod.path}::{node.name}")
+                    self.functions[fqual] = (mod, node)
+                    flocal[node.name] = (mod, node)
+            self._funcs_by_module[mod.path] = flocal
+        for _ in range(2):  # pass 2 sees pass 1's attr/return models
+            for mod in modules:
+                self._infer_module(mod)
+
+    # ----------------------------------------------------- class lookup
+    def resolve_class(self, mod: ModuleInfo,
+                      node: ast.AST) -> Optional[ClassModel]:
+        """The :class:`ClassModel` a Name/Attribute refers to, through
+        ``mod``'s import aliases; same-module classes win, then the
+        alias-qualified registry, then a unique simple-name match."""
+        dn = dotted_name(node)
+        if dn is not None and dn in self._by_module.get(mod.path, {}):
+            return self._by_module[mod.path][dn]
+        resolved = mod.resolve(node)
+        if resolved is None:
+            return None
+        if resolved in self.classes:
+            return self.classes[resolved]
+        simple = resolved.split(".")[-1]
+        cands = self._by_simple.get(simple, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def class_named(self, qualname: str) -> Optional[ClassModel]:
+        return self.classes.get(qualname)
+
+    def resolve_function(self, mod: ModuleInfo,
+                         node: ast.AST) -> Optional[str]:
+        """Qualified name of the module-level function a call target
+        refers to (same-module def, then alias-resolved registry)."""
+        dn = dotted_name(node)
+        if dn is not None and dn in self._funcs_by_module.get(mod.path, {}):
+            m, _fn = self._funcs_by_module[mod.path][dn]
+            name = module_dotted_name(m.path)
+            return (f"{name}.{dn}" if name else f"{m.path}::{dn}")
+        resolved = mod.resolve(node)
+        if resolved is not None and resolved in self.functions:
+            return resolved
+        return None
+
+    def function_named(self, qualname: str
+                       ) -> Optional[Tuple[ModuleInfo, ast.AST]]:
+        return self.functions.get(qualname)
+
+    def owner_class(self, mod: ModuleInfo,
+                    fn: ast.AST) -> Optional[ClassModel]:
+        """The ClassModel whose body directly holds ``fn``, else None."""
+        p = mod.parents.get(fn)
+        while p is not None:
+            if isinstance(p, ast.ClassDef):
+                for cm in self._by_module.get(mod.path, {}).values():
+                    if cm.node is p:
+                        return cm
+                return None
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None  # a def nested in a def has no `self` model
+            p = mod.parents.get(p)
+        return None
+
+    # --------------------------------------------------- type inference
+    def _infer_module(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._infer_function(mod, node)
+
+    def expr_type(self, mod: ModuleInfo, owner: Optional[ClassModel],
+                  env: Dict[str, str], expr: ast.AST) -> Optional[str]:
+        """Qualified class name ``expr`` evaluates to, where inferable:
+        constructor calls (scanned classes AND the
+        :data:`KNOWN_EXTERNAL_TYPES` like ``threading.Thread``), typed
+        locals, ``self.<attr>`` through the class attribute model, and
+        builder-method returns."""
+        if isinstance(expr, ast.Call):
+            cm = self.resolve_class(mod, expr.func)
+            if cm is not None:
+                return cm.qualname
+            resolved = mod.resolve(expr.func)
+            if resolved in KNOWN_EXTERNAL_TYPES:
+                return resolved
+            # builder call: self.make_x(...) with a known return type
+            callee = expr.func
+            if (owner is not None and isinstance(callee, ast.Attribute)
+                    and isinstance(callee.value, ast.Name)
+                    and callee.value.id == "self"):
+                return owner.return_types.get(callee.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and owner is not None:
+                return owner.qualname
+            return env.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self.expr_type(mod, owner, env, expr.value)
+            if base is not None:
+                cm = self.classes.get(base)
+                if cm is not None:
+                    return cm.attr_types.get(expr.attr)
+        return None
+
+    def local_env(self, mod: ModuleInfo, fn: ast.AST) -> Dict[str, str]:
+        """Inferred local-variable types for one function body (a fresh
+        forward pass; class models are already fixed by construction)."""
+        return self._infer_function(mod, fn, record=False)
+
+    def _infer_function(self, mod: ModuleInfo, fn: ast.AST,
+                        record: bool = True) -> Dict[str, str]:
+        owner = self.owner_class(mod, fn)
+        env: Dict[str, str] = {}
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+                t = self.expr_type(mod, owner, env, stmt.value)
+                if t is None:
+                    continue
+                if isinstance(target, ast.Name):
+                    env[target.id] = t
+                elif record and isinstance(target, ast.Attribute) \
+                        and isinstance(target.value, ast.Name):
+                    if target.value.id == "self" and owner is not None:
+                        owner.attr_types[target.attr] = t
+                    else:
+                        base = env.get(target.value.id)
+                        cm = self.classes.get(base) if base else None
+                        if cm is not None:
+                            cm.attr_types[target.attr] = t
+            elif record and isinstance(stmt, ast.Return) \
+                    and stmt.value is not None and owner is not None \
+                    and mod.enclosing_function(stmt) is fn:
+                t = self.expr_type(mod, owner, env, stmt.value)
+                if t is not None and isinstance(
+                        fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    owner.return_types.setdefault(fn.name, t)
+        return env
+
+
 # ------------------------------------------------------------ loop utilities
 
 #: the repo's jitted-step naming convention (R5 polices it stays
@@ -461,6 +689,11 @@ def is_step_call(call: ast.Call) -> bool:
 
 # -------------------------------------------------------------------- registry
 
+#: rule suites the CLI can select (``--suite``): the per-file tracing
+#: rules (R*) and the whole-program concurrency analyses (T*)
+SUITES = ("tracing", "concurrency")
+
+
 class Rule:
     """Base class: subclasses set ``rule_id``/``name``/``hint`` and yield
     :class:`Finding` from :meth:`check`."""
@@ -469,6 +702,8 @@ class Rule:
     name: str = ""
     #: one-line generic fix hint; rules may emit per-finding hints instead
     hint: str = ""
+    #: which ``--suite`` selects this rule
+    suite: str = "tracing"
 
     def check(self, mod: ModuleInfo) -> Iterator[Finding]:
         raise NotImplementedError
@@ -480,6 +715,21 @@ class Rule:
         return Finding(self.rule_id, mod.path, line, col, message,
                        hint if hint is not None else self.hint,
                        mod.snippet(line))
+
+
+class ProgramRule(Rule):
+    """A rule that needs the whole program at once (the concurrency
+    suite).  Subclasses implement :meth:`check_program`; the per-module
+    :meth:`check` is intentionally inert so the registry can hold both
+    kinds."""
+
+    suite = "concurrency"
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        return iter(())
+
+    def check_program(self, prog: ProgramInfo) -> Iterator[Finding]:
+        raise NotImplementedError
 
 
 _REGISTRY: Dict[str, Rule] = {}
@@ -497,18 +747,50 @@ def register(cls):
 def all_rules() -> Dict[str, Rule]:
     # import side effect: rule modules self-register on first use
     from pdnlp_tpu.analysis import rules  # noqa: F401
+    from pdnlp_tpu.analysis import concurrency  # noqa: F401
     return dict(sorted(_REGISTRY.items()))
 
 
-def run_rules(mod: ModuleInfo, rule_ids: Optional[List[str]] = None
-              ) -> List[Finding]:
-    """All non-suppressed findings for one module, sorted by location."""
+def select_rules(rule_ids: Optional[List[str]] = None,
+                 suite: str = "all") -> Dict[str, Rule]:
+    """The registry filtered by suite then by explicit ids."""
     rules = all_rules()
+    if suite != "all":
+        rules = {rid: r for rid, r in rules.items() if r.suite == suite}
     if rule_ids:
         rules = {rid: r for rid, r in rules.items() if rid in rule_ids}
+    return rules
+
+
+def run_rules(mod: ModuleInfo, rule_ids: Optional[List[str]] = None,
+              suite: str = "all") -> List[Finding]:
+    """All non-suppressed per-module findings for one module, sorted by
+    location (program rules run separately via :func:`run_program_rules`)."""
     findings: Set[Finding] = set()  # set: nested traced defs are walked from
-    for rule in rules.values():     # both scopes and would double-report
+    for rule in select_rules(rule_ids, suite).values():  # both scopes and
+        if isinstance(rule, ProgramRule):                # would double-report
+            continue
         for f in rule.check(mod):
             if not mod.suppressions.is_suppressed(f.line, f.rule_id):
                 findings.add(f)
+    return sorted(findings, key=Finding.sort_key)
+
+
+def run_program_rules(prog: "ProgramInfo",
+                      rule_ids: Optional[List[str]] = None,
+                      suite: str = "all") -> List[Finding]:
+    """All non-suppressed whole-program findings, sorted by location.
+    Suppressions apply per finding against the module the finding lands
+    in — the same inline ``# jaxlint: disable=`` contract as the per-file
+    rules."""
+    findings: Set[Finding] = set()
+    for rule in select_rules(rule_ids, suite).values():
+        if not isinstance(rule, ProgramRule):
+            continue
+        for f in rule.check_program(prog):
+            mod = prog.modules.get(f.path)
+            if mod is not None and \
+                    mod.suppressions.is_suppressed(f.line, f.rule_id):
+                continue
+            findings.add(f)
     return sorted(findings, key=Finding.sort_key)

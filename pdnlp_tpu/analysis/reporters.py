@@ -56,6 +56,56 @@ def render_json(findings: List[Finding], new: List[Finding], fixed: int,
     }, indent=2)
 
 
+def render_sarif(findings: List[Finding], new: List[Finding],
+                 baseline_used: bool) -> str:
+    """SARIF 2.1.0 for CI annotation and editor ingestion.
+
+    Every finding becomes a ``result`` with a physical location
+    (1-indexed line/column, matching the text reporter); findings that
+    are NEW vs the baseline carry ``level: error``, grandfathered ones
+    ``level: note`` — so a SARIF viewer shows the ratchet the same way
+    the exit code enforces it.  The fix hint rides in each rule's
+    ``help`` and in the result's ``properties.hint``."""
+    rules = all_rules()
+    used = sorted({f.rule_id for f in findings})
+    new_set = set(new)
+    rule_descs = [{
+        "id": rid,
+        "name": rules[rid].name if rid in rules else rid,
+        "shortDescription": {"text": rules[rid].name if rid in rules
+                             else rid},
+        "help": {"text": rules[rid].hint if rid in rules else ""},
+    } for rid in used]
+    results = [{
+        "ruleId": f.rule_id,
+        "level": ("error" if (not baseline_used or f in new_set)
+                  else "note"),
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": f.line,
+                           "startColumn": f.col + 1},
+            },
+        }],
+        "properties": {"hint": f.hint,
+                       "new_vs_baseline": (not baseline_used
+                                           or f in new_set)},
+    } for f in findings]
+    return json.dumps({
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jaxlint",
+                "rules": rule_descs,
+            }},
+            "results": results,
+        }],
+    }, indent=2)
+
+
 def render_rule_table() -> str:
     """``--list-rules``: id, name, and the generic fix hint per rule."""
     rows = [(r.rule_id, r.name, r.hint) for r in all_rules().values()]

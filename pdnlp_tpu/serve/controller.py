@@ -399,7 +399,10 @@ class ServeController:
             active=active,
             standby=snap.get("standby",
                              getattr(self.router, "standby_count", 0)),
-            knobs=snap.get("knobs", self.router.knob_values()),
+            # no eager default: knob_values() takes the pool lock, and
+            # control_snapshot already carries the knobs on every tick
+            knobs=(snap["knobs"] if "knobs" in snap
+                   else self.router.knob_values()),
         )
 
     # --------------------------------------------------------------- decide

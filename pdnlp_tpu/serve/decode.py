@@ -800,7 +800,12 @@ class DecodeBatcher:
                         continue
                 if claims:
                     self._prefill(claims)
-                if self._live_count():
+                with self._lock:
+                    # _slots is mutated under the lock from stop()/kill()
+                    # callers — the between-steps liveness peek must not
+                    # read it bare (threadlint T1)
+                    any_live = self._live_count() > 0
+                if any_live:
                     self._decode_step()
                 with self._lock:
                     self._wake.notify_all()

@@ -662,6 +662,11 @@ class ReplicaRouter:
                 with self._lock:
                     if self._stop or rep.state == "ejected":
                         return
+                    # snapshot the flush-age knob for the out-of-lock
+                    # pack formation below — the knob is written under
+                    # this lock (apply_knob), so reading it after release
+                    # would race the controller (threadlint T1)
+                    wait_ms = self.max_wait_ms
                     # standby -> warming (activate_replica): leave the lock
                     # and re-run the warmup probes — all compile-cache hits
                     # on a warm engine, but the GATE is the same as a
@@ -698,7 +703,7 @@ class ReplicaRouter:
                     pb, _ = form_packed_batch(
                         batch.requests, self.clock(), self.pack_width,
                         rep.flush_rows, self.pack_segments,
-                        self._tokenizer.pad_id, self.max_wait_ms / 1e3)
+                        self._tokenizer.pad_id, wait_ms / 1e3)
                     with self._lock:
                         if self._stop or rep.state in ("ejected", "standby"):
                             # ejected (or drained to standby) mid-pack:
@@ -732,6 +737,11 @@ class ReplicaRouter:
                         slot.metrics.inflight.set(len(pb.requests))
                         slot.metrics.queue_depth.set(rep.queued())
                     batch = pb
+                # _execute's hang-chaos loop polls self._stop lock-free
+                # by design: a wedged worker exists to SIMULATE a stuck
+                # device stream, and flag writes are atomic under the
+                # GIL — the monitor ejects this replica either way
+                # jaxlint: disable=T1
                 self._execute(rep, batch)
                 with self._lock:
                     rep.inflight = []
@@ -1103,17 +1113,28 @@ class ReplicaRouter:
             if self.engine_factory is None:
                 raise ValueError("relaunch needs an engine or a factory")
             engine = self.engine_factory(index)
-        with self._lock:
+
+        def check_slot_free() -> None:
             old = self._slots[index].replica
             if old is not None and old.state not in ("ejected",):
                 raise RuntimeError(
                     f"replica {index} is {old.state}, not ejected")
-            rep = self._make_replica(index, engine)
-            # the dead incarnation's LAST beat is >= stall_timeout old by
-            # construction; a fresh beat must land BEFORE the slot flips
-            # live, or the monitor's very next poll reads the stale age
-            # against a now-alive adapter and falsely ejects the newcomer
-            rep.hb.beat(force=True)
+
+        with self._lock:
+            check_slot_free()
+        # replica construction and the pre-install beat both touch the
+        # filesystem (heartbeat dir + beat file) — they run OUTSIDE the
+        # pool lock (threadlint T3) so a relaunch never serializes
+        # submitters and the monitor behind disk I/O; the slot is
+        # re-validated under the lock before install
+        rep = self._make_replica(index, engine)
+        # the dead incarnation's LAST beat is >= stall_timeout old by
+        # construction; a fresh beat must land BEFORE the slot flips
+        # live, or the monitor's very next poll reads the stale age
+        # against a now-alive adapter and falsely ejects the newcomer
+        rep.hb.beat(force=True)
+        with self._lock:
+            check_slot_free()
             self._slots[index].replica = rep
         self._start_worker(rep)
 
@@ -1224,13 +1245,19 @@ class ReplicaRouter:
 
     def knob_values(self) -> Dict:
         """Current values of every tunable knob (controller sense input +
-        the exporter's ``controller`` source)."""
-        return {"hedge_ms": self.hedge_ms,
-                "max_wait_ms": self.max_wait_ms,
-                "backpressure_at": self.admission.backpressure_at,
-                "shed_at": self.admission.shed_at,
-                "degrade_at": self.admission.degrade_at,
-                "shed_slack_ms": self.admission.shed_slack_ms}
+        the exporter's ``controller`` source).  Reads under the pool lock
+        — the knobs are written there (:meth:`apply_knob`), and a torn
+        multi-knob snapshot would hand the controller a tier ordering no
+        actuation ever installed (threadlint T1).  No caller holds the
+        lock: the telemetry paths (`snapshot`, ejection flush) all run
+        outside it."""
+        with self._lock:
+            return {"hedge_ms": self.hedge_ms,
+                    "max_wait_ms": self.max_wait_ms,
+                    "backpressure_at": self.admission.backpressure_at,
+                    "shed_at": self.admission.shed_at,
+                    "degrade_at": self.admission.degrade_at,
+                    "shed_slack_ms": self.admission.shed_slack_ms}
 
     # -------------------------------------------------------- fleet surface
     def admission_tier(self) -> str:

@@ -39,6 +39,7 @@ from pdnlp_tpu.models.config import args_overrides
 from pdnlp_tpu.parallel import make_global_batch, make_mesh
 from pdnlp_tpu.parallel.sharding import batch_sharding, replicated
 from pdnlp_tpu.train import checkpoint as ckpt
+from pdnlp_tpu.train.async_ckpt import AsyncCheckpointer
 from pdnlp_tpu.train.optim import build_optimizer
 from pdnlp_tpu.train.precision import resolve_dtype
 from pdnlp_tpu.utils.logging import rank0_print
@@ -285,6 +286,11 @@ def run_pretrain(args) -> str:
     rank0_print(f"pretraining {args.model}: {args.epochs} epochs x "
                 f"{len(loader)} steps, batch {args.train_batch_size}, "
                 f"dtype {args.dtype}")
+    # epoch-curve checkpoints ride the async writer: the epoch loop pays
+    # only the device->host snapshot; serialization + the crash-atomic
+    # publish run on the writer thread (same contract as Trainer's
+    # resume saves — at most one save in flight, latest-wins per path)
+    writer = AsyncCheckpointer()
     start = time.time()
     last = None
     for epoch in range(1, args.epochs + 1):
@@ -300,16 +306,23 @@ def run_pretrain(args) -> str:
         if args.pretrain_ckpt_every and epoch % args.pretrain_ckpt_every == 0 \
                 and epoch != args.epochs:
             # epoch-curve checkpoints: lets an accuracy-vs-pretrain-compute
-            # sweep fine-tune from several depths of ONE run
-            ckpt.save_params(
+            # sweep fine-tune from several depths of ONE run.  snapshot()
+            # is collective (every process runs it); submit() no-ops off
+            # rank 0 — the rank-0-writes split of the sync path
+            writer.submit(
                 args.ckpt_path(f"pretrained-e{epoch}.msgpack"),
-                {"params": _mlm_artifact(state["params"])})
+                ckpt.snapshot(_mlm_artifact(state["params"])))
     if last is not None:
         float(jax.device_get(last["loss"]))  # completion barrier
     minutes = (time.time() - start) / 60
     rank0_print(f"pretrain 耗时：{minutes:.4f}分钟")
     path = args.ckpt_path(args.ckpt_name or "pretrained.msgpack")
+    # the final artifact is durability work that must count toward the
+    # reported runtime: publish it synchronously (outside the step loop),
+    # then drain any still-in-flight epoch-curve saves so no partially
+    # published curve file outlives the run
     ckpt.save_params(path, {"params": _mlm_artifact(state["params"])})
+    writer.wait()
     rank0_print(f"pretrained encoder -> {path}")
     return path
 

@@ -1,0 +1,58 @@
+"""T1 negatives: guarded accesses, init-only attrs, unthreaded classes."""
+import threading
+
+
+class WellLocked:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._items = []
+        self._stop = False
+        self._limit = 8  # set once at construction: read-only is safe
+
+    def start(self):
+        self._stop = False  # lifecycle thread only: not thread-reachable
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def submit(self, x):
+        with self._lock:
+            self._items = self._items + [x]
+            self._wake.notify()
+
+    def stop(self):
+        with self._lock:
+            self._stop = True
+            self._wake.notify()
+
+    def _pop_locked(self):
+        # every call site holds the lock: entry-held covers these reads
+        return self._items[0] if self._items else None
+
+    def _run(self):
+        while True:
+            with self._wake:  # the Condition IS the lock
+                if self._stop:
+                    return
+                item = self._pop_locked()
+            if item is None and self._limit > 4:  # init-only attr
+                continue
+
+
+class Unthreaded:
+    """Owns a lock but never spawns a thread — nothing can race."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def sample(self):
+        with self._lock:
+            return self._n
+
+    def read_bare(self):
+        return self._n  # no second thread exists: not a finding

@@ -1,0 +1,39 @@
+"""T1 positives: lock-guarded attrs touched off-lock on worker paths."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending = 0
+        self._stop = False
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self):
+        with self._lock:
+            self._pending += 1
+
+    def rate(self):
+        with self._cond:  # Condition(self._lock) aliases the lock
+            return self._pending
+
+    def stop(self):
+        with self._lock:
+            self._stop = True
+
+    def _drain(self):
+        self._pending = 0  # helper: judged at its call sites
+
+    def _run(self):
+        while True:
+            if self._pending > 10:  # line 34: bare read on the worker
+                self._drain()       # line 35: unlocked call to helper
+            with self._lock:
+                if self._stop:
+                    return
+            self._stop = False      # line 39: bare write on the worker

@@ -1,0 +1,27 @@
+"""T2 negatives: one global order; Condition aliasing is not a cycle."""
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cond = threading.Condition(self._a)
+
+    def one(self):
+        with self._a:
+            with self._b:  # a -> b
+                pass
+
+    def two(self):
+        with self._a:
+            self._locked_b()  # a -> b again: same order, no cycle
+
+    def _locked_b(self):
+        with self._b:
+            pass
+
+    def wake(self):
+        with self._a:
+            with self._cond:  # same lock group: re-entry, not an edge
+                self._cond.notify()

@@ -1,0 +1,21 @@
+"""T2 positive: opposite acquisition orders across two methods."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+
+    def credit(self):
+        with self._accounts:
+            with self._audit:  # line 12: accounts -> audit
+                pass
+
+    def debit(self):
+        with self._audit:
+            self._locked_accounts()  # line 17: audit -> accounts (interproc)
+
+    def _locked_accounts(self):
+        with self._accounts:
+            pass

@@ -1,0 +1,39 @@
+"""T3 negatives: bounded waits, sanctioned Condition.wait, IO after
+release (the snapshot-then-work pattern)."""
+import queue
+import threading
+import time
+
+
+class Bounded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._q = queue.Queue()
+
+    def wait_work(self):
+        with self._cond:
+            self._cond.wait(timeout=0.05)  # bounded
+            self._cond.wait()  # sanctioned: the held lock's condition
+
+    def poll(self):
+        with self._lock:
+            try:
+                return self._q.get(timeout=0.01)  # bounded
+            except queue.Empty:
+                return None
+
+    def peek(self):
+        with self._lock:
+            if self._q.empty():
+                return None
+            return self._q.get_nowait()
+
+    def snapshot_then_write(self, state):
+        with self._lock:
+            snap = dict(state)
+        with open("/tmp/t3neg.txt", "w") as f:  # IO after release: the fix
+            f.write(str(snap))
+
+    def sleep_unlocked(self):
+        time.sleep(0.01)  # no lock held

@@ -1,0 +1,36 @@
+"""T3 positives: unbounded blocking inside a lock's critical section."""
+import queue
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def drain(self):
+        with self._lock:
+            item = self._q.get()  # line 14: unbounded queue wait
+        return item
+
+    def nap(self):
+        with self._lock:
+            time.sleep(0.5)  # line 19: sleep holds the lock
+
+    def fetch(self, fut):
+        with self._lock:
+            return fut.result()  # line 23: future wait, no timeout
+
+    def dispatch(self, batch):
+        with self._lock:
+            out = self._jit_forward(batch)  # line 27: jit under the lock
+        return out
+
+    def checkpoint(self, state):
+        with self._lock:
+            self._write(state)  # line 32: helper does file I/O
+
+    def _write(self, state):
+        with open("/tmp/t3.txt", "w") as f:
+            f.write(str(state))

@@ -398,6 +398,278 @@ def test_r16_hint_names_the_fix():
     assert "dynamic_update_slice" in f.hint
 
 
+# ------------------------------------------------- concurrency suite (T1-T3)
+
+def test_t1_unguarded_attr_positive():
+    # bare worker-path read (34), unlocked call to a helper that touches
+    # a guarded attr (35), bare worker-path write (39)
+    assert all_hits("t1_pos.py") == [("T1", 34), ("T1", 35), ("T1", 39)]
+
+
+def test_t1_unguarded_attr_negative():
+    # condition aliasing, entry-held helpers, init-only attrs, lifecycle
+    # methods off the worker path, and lock-owning UNthreaded classes
+    assert hits("t1_neg.py", "T1") == []
+
+
+def test_t1_message_names_the_lock_and_attr():
+    path = os.path.join(FIXTURES, "t1_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "T1"][0]
+    assert "Pool._lock" in f.message and "_pending" in f.message
+
+
+def test_t2_lock_order_cycle_positive():
+    # ONE finding for the accounts/audit cycle, placed on the inner
+    # acquisition of the first edge, citing all edges (including the
+    # interprocedural one through _locked_accounts)
+    got = hits("t2_pos.py", "T2")
+    assert got == [("T2", 12)]
+    path = os.path.join(FIXTURES, "t2_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "T2"][0]
+    assert "_accounts" in f.message and "_audit" in f.message
+    assert "t2_pos.py:17" in f.message  # the interprocedural call site
+
+
+def test_t2_lock_order_cycle_negative():
+    assert hits("t2_neg.py", "T2") == []
+
+
+def test_t3_blocking_under_lock_positive():
+    # queue wait (14), sleep (19), future wait (23), jit dispatch (27),
+    # and file I/O reached through a helper (32, citing _write's open)
+    assert all_hits("t3_pos.py") == [
+        ("T3", 14), ("T3", 19), ("T3", 23), ("T3", 27), ("T3", 32)]
+
+
+def test_t3_blocking_under_lock_negative():
+    assert hits("t3_neg.py", "T3") == []
+
+
+def test_t3_interprocedural_finding_cites_the_io_line():
+    path = os.path.join(FIXTURES, "t3_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "T3" and x.line == 32][0]
+    assert "t3_pos.py:35" in f.message and "open" in f.message
+
+
+def test_concurrency_suppression_honored():
+    # the commented write is silenced; the bare read right after fires
+    assert hits("t_suppressed.py", "T1") == [("T1", 25)]
+
+
+def test_suite_selection_partitions_rules():
+    path = os.path.join(FIXTURES, "t1_pos.py")
+    assert analyze_paths([path], root=REPO, suite="tracing") == []
+    conc = analyze_paths([path], root=REPO, suite="concurrency")
+    assert {f.rule_id for f in conc} == {"T1"}
+    r1 = os.path.join(FIXTURES, "r1_pos.py")
+    assert analyze_paths([r1], root=REPO, suite="concurrency") == []
+    assert {f.rule_id
+            for f in analyze_paths([r1], root=REPO, suite="tracing")} \
+        == {"R1"}
+
+
+def test_concurrency_baseline_ratchet(tmp_path):
+    import shutil
+
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "t3_pos.py"), tree / "old.py")
+    found = analyze_paths([str(tree)], root=str(tmp_path))
+    assert {f.rule_id for f in found} == {"T3"}
+    base = tmp_path / "base.json"
+    baseline.write(found, str(base))
+    # unchanged tree: the grandfathered T findings are not new
+    new, fixed = baseline.compare(
+        analyze_paths([str(tree)], root=str(tmp_path)),
+        baseline.load(str(base)))
+    assert new == [] and fixed == 0
+    # a fresh concurrency hazard IS new
+    (tree / "fresh.py").write_text(
+        "import threading, time\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(1)\n")
+    new, _ = baseline.compare(
+        analyze_paths([str(tree)], root=str(tmp_path)),
+        baseline.load(str(base)))
+    assert [(f.rule_id, f.path, f.line) for f in new] == \
+        [("T3", "tree/fresh.py", 10)]
+
+
+# ------------------------------------------------- interprocedural core
+
+def test_program_info_resolves_cross_object_attr_types():
+    """The `rep.hb = Heartbeat(...)` pattern: an attribute assigned
+    through a typed local lands on the local's class model, so
+    `rep.hb.beat(...)` resolves cross-module."""
+    from pdnlp_tpu.analysis.core import ProgramInfo, parse_module
+    router = os.path.join(REPO, "pdnlp_tpu", "serve", "router.py")
+    watchdog = os.path.join(REPO, "pdnlp_tpu", "parallel", "watchdog.py")
+    prog = ProgramInfo([
+        parse_module(router, "pdnlp_tpu/serve/router.py"),
+        parse_module(watchdog, "pdnlp_tpu/parallel/watchdog.py")])
+    rep = prog.classes["pdnlp_tpu.serve.router._Replica"]
+    assert rep.attr_types["hb"] == "pdnlp_tpu.parallel.watchdog.Heartbeat"
+    rr = prog.classes["pdnlp_tpu.serve.router.ReplicaRouter"]
+    assert rr.return_types["_make_replica"] \
+        == "pdnlp_tpu.serve.router._Replica"
+
+
+def test_concurrency_model_sees_condition_aliasing_and_threads():
+    from pdnlp_tpu.analysis.core import ProgramInfo, parse_module
+    from pdnlp_tpu.analysis.concurrency.model import ConcurrencyModel
+    path = os.path.join(FIXTURES, "t1_pos.py")
+    prog = ProgramInfo([parse_module(path, "t1_pos.py")])
+    model = ConcurrencyModel(prog)
+    groups = model.lock_groups("t1_pos.Pool")
+    assert groups["_cond"] == "_lock"  # Condition(self._lock) aliases
+    assert model.class_is_threaded("t1_pos.Pool")
+    assert "m:t1_pos.Pool._run" in model.thread_reachable
+    assert "m:t1_pos.Pool._drain" in model.thread_reachable  # closure
+    assert "m:t1_pos.Pool.submit" not in model.thread_reachable
+
+
+def test_entry_held_infers_helper_lock_context():
+    from pdnlp_tpu.analysis.core import ProgramInfo, parse_module
+    from pdnlp_tpu.analysis.concurrency.model import ConcurrencyModel
+    path = os.path.join(FIXTURES, "t1_neg.py")
+    prog = ProgramInfo([parse_module(path, "t1_neg.py")])
+    model = ConcurrencyModel(prog)
+    entry = model.entry_held("t1_neg.WellLocked")
+    assert entry["_pop_locked"] == \
+        frozenset({("C", "t1_neg.WellLocked", "_lock")})
+    assert entry["_run"] == frozenset()
+
+
+def test_repo_serve_surface_concurrency_clean():
+    """The triage pin: the serving stack and the async checkpointer run
+    clean on the concurrency suite (every real finding in this tree was
+    fixed or suppressed-with-reason in place; a reintroduction is a NEW
+    finding and fails the surface ratchet below)."""
+    paths = [os.path.join(REPO, "pdnlp_tpu", "serve"),
+             os.path.join(REPO, "pdnlp_tpu", "parallel", "watchdog.py"),
+             os.path.join(REPO, "pdnlp_tpu", "train", "async_ckpt.py")]
+    found = analyze_paths(paths, root=REPO, suite="concurrency")
+    assert found == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule_id} {f.message}" for f in found)
+
+
+# ------------------------------------------------------------------- sarif
+
+def test_sarif_round_trips_a_mixed_report(tmp_path):
+    """--format sarif on a tree with tracing AND concurrency findings:
+    the SARIF results map 1:1 back onto analyze_paths' findings (rule,
+    file, 1-indexed line/col), and rule metadata rides along."""
+    import shutil
+
+    tree = tmp_path / "t"
+    tree.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "r1_pos.py"), tree / "a.py")
+    shutil.copy(os.path.join(FIXTURES, "t3_pos.py"), tree / "b.py")
+    env = {**os.environ, "PYTHONPATH": REPO}
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "lint_tpu.py"),
+         "--format", "sarif", "--no-baseline", str(tree)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path))
+    assert out.returncode == 1  # findings exist and count as new
+    sarif = json.loads(out.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "jaxlint"
+    got = {(res["ruleId"],
+            res["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+            res["locations"][0]["physicalLocation"]["region"]["startLine"],
+            res["locations"][0]["physicalLocation"]["region"]["startColumn"])
+           for res in run["results"]}
+    want = {(f.rule_id, f.path, f.line, f.col + 1)
+            for f in analyze_paths([str(tree)], root=str(tmp_path))}
+    assert got == want
+    # every referenced rule is declared with its fix hint
+    declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {res["ruleId"] for res in run["results"]} <= declared
+    assert all(res["level"] == "error" for res in run["results"])
+    assert all(res["properties"]["hint"] for res in run["results"])
+
+
+def test_sarif_baseline_marks_grandfathered_as_notes(tmp_path):
+    import shutil
+
+    tree = tmp_path / "t"
+    tree.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "t3_pos.py"), tree / "b.py")
+    env = {**os.environ, "PYTHONPATH": REPO}
+    base = tmp_path / "base.json"
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "lint_tpu.py"),
+         "--write-baseline", "--baseline", str(base), str(tree)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "lint_tpu.py"),
+         "--format", "sarif", "--baseline", str(base), str(tree)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path))
+    assert out.returncode == 0  # nothing new vs baseline
+    sarif = json.loads(out.stdout)
+    results = sarif["runs"][0]["results"]
+    assert results and all(r["level"] == "note" for r in results)
+
+
+def test_partial_suite_scopes_the_baseline(tmp_path):
+    """--suite concurrency must not count the unscanned tracing debt as
+    'fixed', and --write-baseline refuses under a partial scan — a
+    suite-filtered baseline would silently drop the other suite's
+    grandfathered findings."""
+    import shutil
+
+    tree = tmp_path / "t"
+    tree.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "r1_pos.py"), tree / "a.py")
+    shutil.copy(os.path.join(FIXTURES, "t3_pos.py"), tree / "b.py")
+    env = {**os.environ, "PYTHONPATH": REPO}
+    base = tmp_path / "base.json"
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "lint_tpu.py"),
+             "--baseline", str(base), *extra, str(tree)],
+            capture_output=True, text=True, env=env, cwd=str(tmp_path))
+
+    assert run("--write-baseline").returncode == 0
+    out = run("--suite", "concurrency", "--json")
+    assert out.returncode == 0
+    report = json.loads(out.stdout)
+    assert report["summary"]["new"] == 0
+    assert report["summary"]["fixed_vs_baseline"] == 0  # R debt ≠ fixed
+    refused = run("--suite", "concurrency", "--write-baseline")
+    assert refused.returncode == 2
+    assert "refusing" in refused.stderr
+
+
+def test_bench_refuses_when_lint_gate_fails(monkeypatch):
+    """bench.py smokes refuse to run on a tree carrying NEW findings —
+    the leaked-env refusal pattern.  With the baseline emptied out, every
+    grandfathered finding reads as new and the gate must exit; against
+    the real committed baseline it must pass."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_gate_test", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._lint_gate()  # real tree vs real baseline: clean
+
+    from pdnlp_tpu.analysis import baseline as baseline_mod
+    monkeypatch.setattr(baseline_mod, "load", lambda path: [])
+    with pytest.raises(SystemExit) as e:
+        bench._lint_gate()
+    assert "jaxlint gate FAILED" in str(e.value)
+
+
 def test_findings_carry_exact_location_and_hint():
     path = os.path.join(FIXTURES, "r1_pos.py")
     f = analyze_paths([path], root=REPO)[0]
@@ -407,10 +679,17 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    # the registry sorts by id STRING (R10..R16 between R1 and R2)
+    # the registry sorts by id STRING (R10..R16 between R1 and R2; the
+    # concurrency suite's T1-T3 after the R's)
     assert list(all_rules()) == ["R1", "R10", "R11", "R12", "R13", "R14",
                                  "R15", "R16", "R2", "R3", "R4", "R5",
-                                 "R6", "R7", "R8", "R9"]
+                                 "R6", "R7", "R8", "R9",
+                                 "T1", "T2", "T3"]
+    suites = {rid: r.suite for rid, r in all_rules().items()}
+    assert all(s == "concurrency" for rid, s in suites.items()
+               if rid.startswith("T"))
+    assert all(s == "tracing" for rid, s in suites.items()
+               if rid.startswith("R"))
 
 
 # -------------------------------------------------------------- suppressions

@@ -553,3 +553,98 @@ def test_serve_tpu_sigterm_drains_and_flushes(tmp_path, corpus_path):
     assert snap["requests_total"] >= 3
     trace_files = list((tmp_path / "trace").glob("trace_proc*.jsonl"))
     assert trace_files, "trace spans not flushed on shutdown"
+
+
+# ---------------------------------------------- threadlint fix regressions
+class _OwnerLock:
+    """Lock proxy that records the owning thread — Condition-compatible,
+    so tests can assert 'this thread does NOT hold the pool lock here'
+    without the ambiguity of Lock.locked() (which any thread trips)."""
+
+    def __init__(self):
+        self._l = threading.Lock()
+        self.owner = None
+
+    def acquire(self, *a, **kw):
+        got = self._l.acquire(*a, **kw)
+        if got:
+            self.owner = threading.get_ident()
+        return got
+
+    def release(self):
+        self.owner = None
+        self._l.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def test_relaunch_does_no_file_io_under_the_pool_lock(monkeypatch):
+    """threadlint T3 regression: replica construction and the
+    pre-install beat both write heartbeat files — relaunch must run them
+    OUTSIDE the pool lock so submitters never queue behind disk I/O,
+    while the fresh-beat-before-install ordering (no false ejection of
+    the newcomer) still holds."""
+    from pdnlp_tpu.parallel import watchdog
+
+    r, _ = _router(n=2, start=False)
+    r._lock = _OwnerLock()
+    r._cond = threading.Condition(r._lock)
+    r.start()
+    assert r.wait_ready(10)
+    violations = []
+    real_beat = watchdog.Heartbeat.beat
+
+    def checked_beat(self, *a, **kw):
+        if r._lock.owner == threading.get_ident():
+            violations.append("heartbeat write under the pool lock")
+        return real_beat(self, *a, **kw)
+
+    monkeypatch.setattr(watchdog.Heartbeat, "beat", checked_beat)
+    try:
+        r.kill_replica(1, "crash")
+        deadline = time.monotonic() + 10
+        while r.states[1] != "ejected" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert r.states[1] == "ejected"
+        r.relaunch(1, engine=FakeEngine())
+        assert r.wait_ready(10)
+        assert violations == []
+        assert r.states[1] in ("warming", "healthy")
+    finally:
+        r.stop(drain=False)
+
+
+def test_knob_values_reads_under_the_pool_lock():
+    """threadlint T1 regression: the knob snapshot synchronizes with
+    apply_knob writers (a torn multi-knob read could hand the controller
+    a tier ordering no actuation ever installed)."""
+    r, _ = _router(n=1)
+    try:
+        got = {}
+        acquired = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with r._lock:
+                acquired.set()
+                release.wait(timeout=5)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert acquired.wait(timeout=5)
+        t2 = threading.Thread(
+            target=lambda: got.update(knobs=r.knob_values()), daemon=True)
+        t2.start()
+        t2.join(timeout=0.2)
+        assert "knobs" not in got  # blocked behind the lock holder
+        release.set()
+        t2.join(timeout=5)
+        t.join(timeout=5)
+        assert got["knobs"]["max_wait_ms"] == r.max_wait_ms
+    finally:
+        r.stop(drain=False)
