@@ -224,7 +224,17 @@ def decode_smoke(argv) -> None:
       stream's hop chain must validate through the trace-file round trip
       AND every stream must emit EXACTLY the single-engine reference
       token sequence (orphans re-prefill on the survivor — no duplicated,
-      no lost tokens).
+      no lost tokens);
+    - **paged shared-prefix storm** (phase D, the paged-KV gate): an
+      80%-shared prompt mix at EQUAL ``--kv_hbm_mb`` must seat >= 3x the
+      slot layout's concurrent streams (peak live), every stream
+      token-identical to the slot-cache baseline, a prefix-hit resubmit
+      must run ZERO prefill forwards (TTFT bounded by one decode-step
+      latency, by construction: the stored first token is emitted at
+      claim), zero post-warmup retraces on the paged path, and the page
+      allocator's ledger must reconcile to ZERO leaked pages after drain
+      — including through a 2-replica paged kill storm whose re-prefilled
+      survivors re-attach to shared prefix pages.
 
     Deterministic and CPU-safe (seeded prompts over a synthetic vocab,
     greedy decode, EOS disabled so token counts are exact); snapshot at
@@ -238,7 +248,9 @@ def decode_smoke(argv) -> None:
 
     from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, build_vocab
     from pdnlp_tpu.obs.request import validate_chains
-    from pdnlp_tpu.serve import DecodeBatcher, DecodeEngine, DecodeRouter
+    from pdnlp_tpu.serve import (
+        DecodeBatcher, DecodeEngine, DecodeRouter, PagedDecodeEngine,
+    )
     from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
 
     argv, n_streams = pop_cli_flag(argv, "--decode_streams", 48, int)
@@ -360,6 +372,132 @@ def decode_smoke(argv) -> None:
                 records.append(json.loads(line))
     report = validate_chains(records, [s.rid for s in kstreams])
 
+    # ----------------------------- phase D: paged shared-prefix storm
+    # The paged-KV capacity claim, head to head at EQUAL --kv_hbm_mb: a
+    # budget worth FOUR max-length slot stripes, an 80%-shared prompt
+    # mix (one 32-token system prefix + short distinct suffixes; every
+    # 5th prompt unique), and the same storm driven through (a) the slot
+    # layout — capped to 4 slots — and (b) the paged layout, whose
+    # shared streams pin the prefix's 2 pages once and reserve ~1
+    # private page each.  Gates: >= 3x peak concurrent live streams,
+    # token parity stream for stream, a structurally-zero-prefill
+    # full-hit resubmit, zero post-warmup retraces, and a reconciled
+    # (zero-leak) page ledger after drain — then once more through a
+    # 2-replica paged kill storm.
+    pd_slots, pd_page_sz, pd_max_len, pd_max_new = 16, 16, 96, 8
+    probe_eng = PagedDecodeEngine(
+        parse_cli([], base=Args(model="bert-tiny", decode_slots=1,
+                                decode_max_len=pd_max_len,
+                                kv_page_sz=pd_page_sz)),
+        tokenizer=tok, mesh=None, buckets=buckets)
+    budget_mb = 4 * probe_eng.token_bytes * pd_max_len / 2**20
+    del probe_eng
+
+    def pd_args():
+        return parse_cli([], base=Args(
+            model="bert-tiny", decode_slots=pd_slots,
+            decode_max_len=pd_max_len, max_new_tokens=pd_max_new,
+            kv_page_sz=pd_page_sz, kv_hbm_mb=budget_mb,
+            seed=args.seed))
+
+    n_shared_storm = 60
+    shared_prefix = rng.integers(5, tok.vocab_size, 32).tolist()
+    # one warm stream carries the shared prefix through a full prefill
+    # BEFORE the storm (the realistic shape: the prefix is indexed from
+    # earlier traffic) — without it the opening claim burst is all-cold
+    # and the concurrency comparison measures nothing but the cold pool
+    warm_prompt = shared_prefix + rng.integers(5, tok.vocab_size,
+                                               4).tolist()
+    storm_prompts = []
+    for i in range(n_shared_storm):
+        if i % 5 == 4:      # 20%: unique, same total length
+            storm_prompts.append(
+                rng.integers(5, tok.vocab_size, 36).tolist())
+        else:               # 80%: shared 32-token prefix, distinct tail
+            storm_prompts.append(
+                shared_prefix + rng.integers(5, tok.vocab_size,
+                                             4).tolist())
+
+    def pd_storm(engine):
+        b = DecodeBatcher(engine, max_waiting=n_shared_storm).start()
+        b.eos_id = -1
+        b.warmup()
+        r0 = engine.metrics.retraces.value
+        m0 = engine.metrics.cache_misses.value
+        # identical warm stream on BOTH layouts (the slot engine just
+        # runs one extra stream, the paged engine also indexes the
+        # shared prefix) so the storms stay apples-to-apples
+        b.submit_ids(warm_prompt,
+                     max_new_tokens=pd_max_new).result(timeout=600)
+        ss = [b.submit_ids(p, max_new_tokens=pd_max_new)
+              for p in storm_prompts]
+        outs = [s.result(timeout=600) for s in ss]
+        return b, outs, r0, m0
+
+    slot_b, slot_outs, _, _ = pd_storm(
+        DecodeEngine(pd_args(), tokenizer=tok, mesh=None,
+                     buckets=buckets))
+    slot_peak = slot_b.metrics.peak_live_streams.value
+    slot_cap = slot_b.engine.slots
+    slot_b.stop()
+
+    paged_eng = PagedDecodeEngine(pd_args(), tokenizer=tok, mesh=None,
+                                  buckets=buckets)
+    paged_b, paged_outs, pd_r0, pd_m0 = pd_storm(paged_eng)
+    paged_peak = paged_b.metrics.peak_live_streams.value
+    # full-hit probe: prime the index with one post-drain submission
+    # (registers the prompt — its storm-time entry may have been under
+    # eviction pressure), then an exact repeat must emit its first token
+    # WITHOUT a prefill forward (TTFT is then bounded by one decode-step
+    # wait, by construction)
+    paged_b.submit_ids(storm_prompts[0],
+                       max_new_tokens=pd_max_new).result(timeout=600)
+    pre0 = paged_b.metrics.prefills_total.value
+    hs = paged_b.submit_ids(storm_prompts[0], max_new_tokens=pd_max_new)
+    hit_out = hs.result(timeout=600)
+    hit_prefills = paged_b.metrics.prefills_total.value - pre0
+    hit_ttft_ms = (hs.first_token_at - hs.born) * 1e3
+    pd_retraces = paged_eng.metrics.retraces.value - pd_r0
+    pd_misses = paged_eng.metrics.cache_misses.value - pd_m0
+    paged_snap = paged_b.snapshot()
+    paged_b.stop()
+    leak = paged_eng.leak_check()
+    paged_eng.prefix.clear()
+    drained_clean = (leak["ok"] and not leak["stream_owners"]
+                     and paged_eng.allocator.free_pages
+                     == paged_eng.n_pages)
+    pd_parity = (paged_outs == slot_outs
+                 and hit_out == slot_outs[0])
+
+    # 2-replica paged kill: orphans re-prefill on the survivor,
+    # re-attaching to ITS shared prefix pages under the same request id
+    pengines = [PagedDecodeEngine(pd_args(), tokenizer=tok, mesh=None,
+                                  buckets=buckets) for _ in range(2)]
+    for e in pengines[1:]:
+        e.tracer = pengines[0].tracer
+    prouter = DecodeRouter(pengines,
+                           max_waiting=n_shared_storm).start()
+    for b in prouter.batchers:
+        b.eos_id = -1
+    prouter.warmup()
+    pkstreams = [prouter.submit_ids(p, max_new_tokens=pd_max_new)
+                 for p in storm_prompts]
+    deadline = time.monotonic() + 120
+    while (prouter.batchers[0].metrics.tokens_out_total.value
+           < pd_max_new * 4 and time.monotonic() < deadline):
+        time.sleep(0.002)
+    prouter.kill(0)
+    pkouts = [s.result(timeout=600) for s in pkstreams]
+    pk_requeued = prouter.batchers[1].rmetrics.requeued_in.value
+    prouter.stop()
+    survivor = prouter.batchers[1].engine
+    pk_leak = survivor.leak_check()
+    pk_hits = survivor.prefix.snapshot()
+    survivor.prefix.clear()
+    pk_clean = (pk_leak["ok"] and not pk_leak["stream_owners"]
+                and survivor.allocator.free_pages == survivor.n_pages)
+    pk_parity = pkouts == slot_outs
+
     # ------------------------------------------------------------- gates
     if speedup < 2.0:
         failures.append(f"decode tokens/s/chip only {speedup:.2f}x the "
@@ -388,6 +526,33 @@ def decode_smoke(argv) -> None:
     if report["requeued"] < 1 or report["re_prefilled"] < 1:
         failures.append("the kill never exercised requeue/re-prefill — "
                         "the chaos leg proved nothing")
+    if paged_peak < 3 * slot_peak:
+        failures.append(
+            f"paged layout peaked at {paged_peak} concurrent streams vs "
+            f"{slot_peak} for the slot layout at equal --kv_hbm_mb "
+            "(gate: >= 3x on the 80%-shared mix)")
+    if not pd_parity:
+        failures.append("paged storm diverged from the slot-cache "
+                        "baseline (greedy continuations must be "
+                        "token-identical)")
+    if hit_prefills != 0:
+        failures.append(f"full prefix hit ran {hit_prefills} prefill "
+                        "forward(s) (gate: structurally zero)")
+    if pd_retraces != 0 or pd_misses != 0:
+        failures.append(f"{pd_retraces} retraces / {pd_misses} compile "
+                        "misses on the paged path post-warmup (gate: 0)")
+    if not drained_clean:
+        failures.append(f"paged storm leaked pages at drain: {leak}")
+    if not pk_parity:
+        failures.append("paged kill storm duplicated or lost tokens "
+                        "(re-prefilled survivors must match the "
+                        "slot-cache baseline)")
+    if pk_requeued < 1:
+        failures.append("the paged kill never requeued a stream — the "
+                        "re-attach leg proved nothing")
+    if not pk_clean:
+        failures.append(f"paged kill storm leaked pages on the "
+                        f"survivor: {pk_leak}")
 
     result = {
         "metric": "decode_smoke",
@@ -424,6 +589,32 @@ def decode_smoke(argv) -> None:
             "chains_requeued": report["requeued"],
             "chains_re_prefilled": report["re_prefilled"],
         },
+        "paged_storm": {
+            "streams": n_shared_storm,
+            "shared_fraction": 0.8,
+            "shared_prefix_tokens": len(shared_prefix),
+            "page_sz": pd_page_sz,
+            "kv_hbm_mb": round(budget_mb, 3),
+            "slot_layout_slots": int(slot_cap),
+            "slot_peak_live": int(slot_peak),
+            "paged_pages": int(paged_eng.n_pages),
+            "paged_peak_live": int(paged_peak),
+            "concurrency_gain": round(paged_peak / max(slot_peak, 1), 2),
+            "token_parity_with_slot_baseline": bool(pd_parity),
+            "full_hit_prefill_forwards": int(hit_prefills),
+            "full_hit_ttft_ms": round(hit_ttft_ms, 2),
+            "retraces_post_warmup": int(pd_retraces),
+            "pages": paged_snap["kv"]["pages"],
+            "prefix": paged_snap["kv"]["prefix"],
+            "leak_check": leak,
+            "kill": {
+                "replicas": 2,
+                "token_parity_with_slot_baseline": bool(pk_parity),
+                "requeued_to_survivor": int(pk_requeued),
+                "survivor_prefix_hits": pk_hits,
+                "survivor_leak_check": pk_leak,
+            },
+        },
         "p99_budget_ms": p99_budget,
         "model": args.model,
         "kv_dtype": engine.kv_snapshot()["kv_dtype"],
@@ -440,6 +631,12 @@ def decode_smoke(argv) -> None:
                                           and occupancy_mean >= 0.8),
             "kill_chains_complete_no_dup_no_loss": bool(
                 kill_parity and not report["incomplete"]),
+            "paged_concurrency_ge_3x": bool(paged_peak >= 3 * slot_peak),
+            "paged_token_parity": bool(pd_parity and pk_parity),
+            "paged_full_hit_zero_prefill": hit_prefills == 0,
+            "paged_zero_post_warmup_retraces": bool(
+                pd_retraces == 0 and pd_misses == 0),
+            "paged_zero_leaked_pages": bool(drained_clean and pk_clean),
         },
         "failures": failures,
     }
@@ -450,7 +647,8 @@ def decode_smoke(argv) -> None:
             json.dump(result, f, indent=2)
         os.replace(tmp, out_path)
     print(json.dumps({k: v for k, v in result.items()
-                      if k not in ("decode", "reprefill_baseline")}))
+                      if k not in ("decode", "reprefill_baseline",
+                                   "paged_storm")}))
     if failures:
         sys.exit("decode smoke FAILED:\n  - " + "\n  - ".join(failures)
                  + f"\n  see {out_path}")

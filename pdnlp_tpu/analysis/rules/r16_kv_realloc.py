@@ -15,6 +15,21 @@ Every token reallocates the whole cache (O(T²) bytes moved over a
 generation) and, under jit, the growing shape retraces the step on every
 single token — the decode analog of the R7/R9 step-loop stalls.
 
+The PAGED layout (``pdnlp_tpu.serve.kvpage``) has its own spelling of the
+same bug: the per-stream page TABLE rebuilt by concatenate as pages are
+claimed, or the page arrays re-stacked per token::
+
+    for _ in range(max_new):
+        logits, new_page = paged_decode_step(tok, pages_k, page_table)
+        page_table = jnp.concatenate([page_table, new_page])       # <- R16
+        pages_k = jnp.stack([pages_k, fresh_pages])                # <- R16
+
+Same two losses: the table/pool reallocates per token, and the growing
+extent retraces the one decode program paging exists to keep fixed.  The
+engine's fix is structural — the table is a fixed ``[slots,
+pages_per_stream]`` host array updated in place at attach/detach, and the
+page pool is preallocated and donated.
+
 Heuristic, per lexical ``for``/``while`` loop (R7/R9's loop-body
 machinery): the loop is DECODE-SHAPED — it dispatches a call whose name's
 last segment contains ``decode``/``prefill``/``generate`` or matches the
@@ -22,12 +37,13 @@ jitted-step convention (``*step``/``*step_fn``) — and the body calls an
 array-concatenation builder (``concatenate``/``append``/``stack``/
 ``hstack``/``vstack``, by import resolution or last-segment name) with any
 argument that names KV state (an identifier matching ``kv``/``cache``/
-``past``, case-insensitive, incl. inside list/tuple literals).  The
+``past``/``page``, case-insensitive — the last covers ``page_table`` /
+``pages_k`` / ``pages_v`` — incl. inside list/tuple literals).  The
 finding lands on the concatenate call.
 
 ``.at[...].set(...)`` and ``lax.dynamic_update_slice`` — the fix — never
 match; neither does concatenation of non-cache values in a decode loop,
-nor a one-time cache assembly outside any decode loop.
+nor a one-time cache/table assembly outside any decode loop.
 """
 from __future__ import annotations
 
@@ -45,19 +61,21 @@ _REBUILD_NAMES = {"concatenate", "append", "stack", "hstack", "vstack",
 _REBUILD_RESOLVED = {f"jax.numpy.{n}" for n in _REBUILD_NAMES} \
     | {f"numpy.{n}" for n in _REBUILD_NAMES}
 _DECODE_CALL_RE = re.compile(r"(decode|prefill|generate)", re.I)
-_CACHE_NAME_RE = re.compile(r"(kv|cache|past)", re.I)
+_CACHE_NAME_RE = re.compile(r"(kv|cache|past|page)", re.I)
 
 
 @register
 class KVCacheReallocInDecodeLoop(Rule):
     rule_id = "R16"
     name = "kv-cache-realloc-in-decode-loop"
-    hint = ("preallocate the KV cache once ([slots, max_len] positions) "
-            "and write new K/V with cache.at[rows, pos].set(...) or "
-            "lax.dynamic_update_slice into a DONATED buffer "
-            "(pdnlp_tpu.serve.decode.DecodeEngine is the engine form) — "
-            "a concatenate rebuild reallocates the whole cache every "
-            "token and the growing shape retraces the jitted step per "
+    hint = ("preallocate the KV storage once ([slots, max_len] positions, "
+            "or a paged pool with a fixed [slots, pages_per_stream] page "
+            "table updated in place) and write new K/V with "
+            "cache.at[rows, pos].set(...) or lax.dynamic_update_slice "
+            "into a DONATED buffer (pdnlp_tpu.serve.decode.DecodeEngine / "
+            "PagedDecodeEngine are the engine forms) — a concatenate "
+            "rebuild reallocates the whole cache or table every token "
+            "and the growing shape retraces the jitted step per "
             "generated token")
 
     def check(self, mod: ModuleInfo) -> Iterator[Finding]:

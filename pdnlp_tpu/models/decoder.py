@@ -258,6 +258,226 @@ def decode_step(params: Params, head: Params, cfg: BertConfig,
     return logits, cache_k, cache_v
 
 
+# ------------------------------------------------------------- paged cache
+#
+# The paged layout stores K/V as fixed-size pages ``[L, n_pages, page_sz,
+# N, D]`` and a per-stream PAGE TABLE maps logical page -> physical page.
+# Every program below works on the FLAT view ``[L, n_pages * page_sz, N,
+# D]`` with host-computed (or in-program) flat indices ``physical_page *
+# page_sz + offset``; dead rows and filler carry the OOB sentinel index
+# ``n_pages * page_sz``, which ``mode="drop"`` scatters ignore and
+# ``mode="fill"`` gathers read as 0.0 — a masked position's exact-zero
+# contribution either way, so the slot-cache bitwise decode contract
+# carries over unchanged (the gather reconstructs the same ``[B, max_len,
+# N, D]`` extent the slot step attends over, with identical values at
+# every visible position).
+
+
+def paged_insert(pages_k: jax.Array,   # [L, P, page_sz, N, D]
+                 pages_v: jax.Array,
+                 ks: jax.Array,        # [L, B, S, N, D] (prefill output)
+                 vs: jax.Array,
+                 flat_pos: jax.Array,  # [B, S] int32 flat indices (OOB drop)
+                 *, kv_scales: Optional[Tuple[jax.Array, jax.Array]] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Scatter a prefill's K/V into pages: the paged analogue of the slot
+    engine's cache insert.  ``flat_pos[b, s]`` is the flat page index for
+    prompt b's position s (padding and filler rows carry the OOB
+    sentinel, so they can never touch a live page)."""
+    L, P, ps = pages_k.shape[0], pages_k.shape[1], pages_k.shape[2]
+    tail = pages_k.shape[3:]
+    if kv_scales is not None:
+        ks = quantize_kv(ks, kv_scales[0][:, None, None])
+        vs = quantize_kv(vs, kv_scales[1][:, None, None])
+    pk = pages_k.reshape(L, P * ps, *tail)
+    pv = pages_v.reshape(L, P * ps, *tail)
+    pk = pk.at[:, flat_pos].set(ks.astype(pk.dtype), mode="drop")
+    pv = pv.at[:, flat_pos].set(vs.astype(pv.dtype), mode="drop")
+    return pk.reshape(pages_k.shape), pv.reshape(pages_v.shape)
+
+
+def copy_pages(pages_k: jax.Array, pages_v: jax.Array,
+               src: jax.Array,      # [n] physical page ids (OOB = no-op)
+               dst: jax.Array       # [n]
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Copy-on-write page duplication: ``pages[dst[i]] = pages[src[i]]``
+    across all layers.  Unused rows carry the OOB sentinel ``P`` on both
+    sides (``mode="fill"`` reads zeros, ``mode="drop"`` discards the
+    write), so ONE fixed row count serves every claim round."""
+    sk = jnp.take(pages_k, src, axis=1, mode="fill", fill_value=0)
+    sv = jnp.take(pages_v, src, axis=1, mode="fill", fill_value=0)
+    pages_k = pages_k.at[:, dst].set(sk, mode="drop")
+    pages_v = pages_v.at[:, dst].set(sv, mode="drop")
+    return pages_k, pages_v
+
+
+def _flat_gather_idx(page_table: jax.Array, page_sz: int) -> jax.Array:
+    """[B, MP] page table -> [B, MP * page_sz] flat gather indices.
+    Sentinel table entries (>= P) map past the flat extent and read 0."""
+    B, MP = page_table.shape
+    offs = jnp.arange(page_sz, dtype=jnp.int32)
+    return (page_table[:, :, None] * page_sz
+            + offs[None, None, :]).reshape(B, MP * page_sz)
+
+
+def paged_decode_step(params: Params, head: Params, cfg: BertConfig,
+                      tokens: jax.Array,      # [B, 1] int32
+                      pages_k: jax.Array,     # [L, P, page_sz, N, D]
+                      pages_v: jax.Array,
+                      page_table: jax.Array,  # [B, MP] int32 (sentinel P)
+                      pos: jax.Array,         # [B] int32 write positions
+                      *, kv_scales: Optional[Tuple[jax.Array,
+                                                   jax.Array]] = None,
+                      dtype=jnp.float32, unroll=True
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`decode_step` over a paged cache: write the current token's
+    K/V at ``page_table[b, pos // page_sz] * page_sz + pos % page_sz``,
+    gather each row's logical ``[max_len]`` view through its table, and
+    attend with the SAME linear visibility mask and extent as the slot
+    step — bitwise-equal logits on bitwise-equal cache contents (module
+    note above).  Shapes are all static ([B, 1] tokens, [B, MP] table,
+    preallocated pages), so the jitted form holds ONE compiled program."""
+    _check_dense_trunk(params["layers"])
+    L, P, ps = pages_k.shape[0], pages_k.shape[1], pages_k.shape[2]
+    tail = pages_k.shape[3:]
+    B, MP = page_table.shape
+    max_len = MP * ps
+    pos = pos.astype(jnp.int32)
+    x, _ = bert.embed(params, cfg, tokens, jnp.zeros_like(tokens),
+                      dtype=dtype, deterministic=True,
+                      position_ids=pos[:, None])
+    visible = (jnp.arange(max_len)[None, :] <= pos[:, None])
+    bias = mask_bias(visible.astype(jnp.float32), jnp.float32)
+    gidx = _flat_gather_idx(page_table, ps)                    # [B, max_len]
+    lp = pos // ps
+    phys = jnp.take_along_axis(page_table, lp[:, None], axis=1)[:, 0]
+    # dead rows ride with sentinel tables: their write lands OOB (dropped)
+    wflat = jnp.where(phys < P, phys * ps + pos % ps, P * ps)  # [B]
+    pk = pages_k.reshape(L, P * ps, *tail)
+    pv = pages_v.reshape(L, P * ps, *tail)
+
+    def layer(carry, scanned):
+        x = carry
+        if kv_scales is None:
+            lp_, _, pk_l, pv_l = scanned
+        else:
+            lp_, _, pk_l, pv_l, ks_l, vs_l = scanned
+        q, k_new, v_new = _qkv(x, lp_, cfg, dtype)             # [B, 1, N, D]
+        if kv_scales is None:
+            pk_l = pk_l.at[wflat].set(k_new[:, 0].astype(pk_l.dtype),
+                                      mode="drop")
+            pv_l = pv_l.at[wflat].set(v_new[:, 0].astype(pv_l.dtype),
+                                      mode="drop")
+            kf = jnp.take(pk_l, gidx, axis=0, mode="fill", fill_value=0)
+            vf = jnp.take(pv_l, gidx, axis=0, mode="fill", fill_value=0)
+        else:
+            pk_l = pk_l.at[wflat].set(quantize_kv(k_new[:, 0], ks_l),
+                                      mode="drop")
+            pv_l = pv_l.at[wflat].set(quantize_kv(v_new[:, 0], vs_l),
+                                      mode="drop")
+            kf = dequantize_kv(
+                jnp.take(pk_l, gidx, axis=0, mode="fill", fill_value=0),
+                ks_l, dtype)
+            vf = dequantize_kv(
+                jnp.take(pv_l, gidx, axis=0, mode="fill", fill_value=0),
+                vs_l, dtype)
+        attn = dot_product_attention(q, kf, vf, bias, impl="auto")
+        return _finish_layer(x, lp_, cfg, attn, dtype), (pk_l, pv_l)
+
+    li = jnp.arange(cfg.num_layers)
+    xs = (params["layers"], li, pk, pv)
+    if kv_scales is not None:
+        xs = xs + (kv_scales[0], kv_scales[1])
+    x, (pk, pv) = jax.lax.scan(layer, x, xs, unroll=unroll)
+    logits = lm_logits(params, head, cfg, x, dtype=dtype)[:, 0]
+    return (logits, pk.reshape(pages_k.shape), pv.reshape(pages_v.shape))
+
+
+def paged_chunk_step(params: Params, head: Params, cfg: BertConfig,
+                     tokens: jax.Array,      # [B, T] int32 (suffix chunk)
+                     pages_k: jax.Array,     # [L, P, page_sz, N, D]
+                     pages_v: jax.Array,
+                     page_table: jax.Array,  # [B, MP] int32 (sentinel P)
+                     start: jax.Array,       # [B] absolute pos of tokens[:,0]
+                     nreal: jax.Array,       # [B] real chunk lengths (0 ok)
+                     *, kv_scales: Optional[Tuple[jax.Array,
+                                                  jax.Array]] = None,
+                     dtype=jnp.float32, unroll=True
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Suffix prefill against a paged cache: the prompt's SHARED prefix
+    pages already hold K/V (a prefix-index hit), so only the divergent
+    suffix runs — ``tokens[b, t]`` sits at absolute position ``start[b] +
+    t``, writes through the page table, and attends to key positions
+    ``<= start + t`` (shared prefix + the chunk's own causal triangle).
+    Returns each row's LAST real token's next-token logits [B, vocab]
+    (fp32), like :func:`prefill`.  Rows with ``nreal == 0`` are filler:
+    their writes land OOB and their logits are garbage the caller
+    discards."""
+    _check_dense_trunk(params["layers"])
+    L, P, ps = pages_k.shape[0], pages_k.shape[1], pages_k.shape[2]
+    tail = pages_k.shape[3:]
+    B, MP = page_table.shape
+    T = tokens.shape[1]
+    max_len = MP * ps
+    start = start.astype(jnp.int32)
+    nreal = nreal.astype(jnp.int32)
+    positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)  # [B, T]
+    x, _ = bert.embed(params, cfg, tokens, jnp.zeros_like(tokens),
+                      dtype=dtype, deterministic=True,
+                      position_ids=positions)
+    # per-query linear visibility: query t sees key j iff j <= start + t
+    vis = (jnp.arange(max_len, dtype=jnp.int32)[None, None, :]
+           <= positions[:, :, None])                     # [B, T, max_len]
+    bias = jnp.where(vis, 0.0, -1e9).astype(jnp.float32)[:, None]
+    gidx = _flat_gather_idx(page_table, ps)
+    # write positions: padded chunk slots (t >= nreal) land OOB
+    in_chunk = jnp.arange(T, dtype=jnp.int32)[None, :] < nreal[:, None]
+    lp = jnp.clip(positions // ps, 0, MP - 1)
+    phys = jnp.take_along_axis(page_table, lp, axis=1)   # [B, T]
+    wflat = jnp.where(in_chunk & (phys < P) & (positions < max_len),
+                      phys * ps + positions % ps, P * ps)
+    pk = pages_k.reshape(L, P * ps, *tail)
+    pv = pages_v.reshape(L, P * ps, *tail)
+
+    def layer(carry, scanned):
+        x = carry
+        if kv_scales is None:
+            lp_, _, pk_l, pv_l = scanned
+        else:
+            lp_, _, pk_l, pv_l, ks_l, vs_l = scanned
+        q, k_new, v_new = _qkv(x, lp_, cfg, dtype)       # [B, T, N, D]
+        if kv_scales is None:
+            pk_l = pk_l.at[wflat].set(k_new.astype(pk_l.dtype),
+                                      mode="drop")
+            pv_l = pv_l.at[wflat].set(v_new.astype(pv_l.dtype),
+                                      mode="drop")
+            kf = jnp.take(pk_l, gidx, axis=0, mode="fill", fill_value=0)
+            vf = jnp.take(pv_l, gidx, axis=0, mode="fill", fill_value=0)
+        else:
+            pk_l = pk_l.at[wflat].set(quantize_kv(k_new, ks_l),
+                                      mode="drop")
+            pv_l = pv_l.at[wflat].set(quantize_kv(v_new, vs_l),
+                                      mode="drop")
+            kf = dequantize_kv(
+                jnp.take(pk_l, gidx, axis=0, mode="fill", fill_value=0),
+                ks_l, dtype)
+            vf = dequantize_kv(
+                jnp.take(pv_l, gidx, axis=0, mode="fill", fill_value=0),
+                vs_l, dtype)
+        attn = dot_product_attention(q, kf, vf, bias, impl="auto")
+        return _finish_layer(x, lp_, cfg, attn, dtype), (pk_l, pv_l)
+
+    li = jnp.arange(cfg.num_layers)
+    xs = (params["layers"], li, pk, pv)
+    if kv_scales is not None:
+        xs = xs + (kv_scales[0], kv_scales[1])
+    x, (pk, pv) = jax.lax.scan(layer, x, xs, unroll=unroll)
+    last = jnp.clip(nreal - 1, 0, T - 1)
+    h_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # [B,1,H]
+    logits = lm_logits(params, head, cfg, h_last, dtype=dtype)[:, 0]
+    return (logits, pk.reshape(pages_k.shape), pv.reshape(pages_v.shape))
+
+
 # ------------------------------------------------------- infilling scoring
 
 def infill_logits(params: Params, head: Params, cfg: BertConfig,

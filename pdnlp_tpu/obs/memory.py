@@ -143,6 +143,27 @@ class KVBudget:
                 "--decode_max_len")
         return min(int(requested), int(fit))
 
+    def cap_pages(self, requested: int, page_bytes: int,
+                  min_pages: int = 1) -> int:
+        """Paged-layout construction door (``serve.kvpage``): how many
+        fixed-size KV pages the declared budget covers (= ``requested``
+        when unbudgeted).  ``min_pages`` is the floor the engine needs to
+        hold ONE maximum-length stream — a budget that cannot cover it
+        refuses loudly here instead of deadlocking every claim.  The
+        page ALLOCATION ledger itself lives in
+        :class:`pdnlp_tpu.serve.kvpage.PageAllocator`; this budget only
+        sizes the pool."""
+        if self.budget_bytes is None:
+            return int(requested)
+        fit = self.budget_bytes // max(1, int(page_bytes))
+        if fit < int(min_pages):
+            raise KVBudgetExceeded(
+                f"kv_hbm_mb={self.budget_bytes / 2**20:.1f} covers only "
+                f"{fit} KV pages ({page_bytes / 2**20:.2f} MB/page) but "
+                f"one maximum-length stream needs {min_pages} — raise "
+                "--kv_hbm_mb or shrink --decode_max_len/--kv_page_sz")
+        return min(int(requested), int(fit))
+
     def check_stream(self, tokens_total: int, token_bytes: int) -> None:
         """Admission door: refuse a stream whose worst-case KV cannot fit
         under the budget (prompt + max_new positions × bytes/position)."""

@@ -30,12 +30,19 @@ requests) — this package applies the same treatment to inference:
   request-hop chains reconstructed into arrival schedules, reshaped
   (steady / diurnal ramp / flash crowd) and re-driven at 1x/5x/20x speed
   (``bench.py --replay``);
-- :mod:`pdnlp_tpu.serve.decode` — generative decoding: a slot-indexed
-  donated KV cache (optionally int8 against calibrated per-channel scale
-  tables), bucketed prefill / one fixed-shape decode step, continuous
-  batching with streaming responses, a declared KV HBM budget
-  (``--kv_hbm_mb``), and a decode replica router whose kill-recovery
-  re-prefills orphan streams on survivors (``serve_tpu.py --decode``).
+- :mod:`pdnlp_tpu.serve.decode` — generative decoding: a paged (default)
+  or slot-indexed donated KV cache (optionally int8 against calibrated
+  per-channel scale tables), bucketed prefill / one fixed-shape decode
+  step, continuous batching with streaming responses, a declared KV HBM
+  budget (``--kv_hbm_mb``), and a decode replica router whose
+  kill-recovery re-prefills orphan streams on survivors
+  (``serve_tpu.py --decode``);
+- :mod:`pdnlp_tpu.serve.kvpage` — the paged KV memory subsystem behind
+  ``--kv_layout paged``: refcounted fixed-size page allocator with a
+  free list, loud :class:`KVPagesExhausted` refusals, a leak-check
+  ledger audit, and an LRU prefix index that shares repeated prompt
+  prefixes across requests at page granularity (copy-on-write at the
+  divergence page).
 
 Entry point: ``serve_tpu.py`` at the repo root.
 """
@@ -46,8 +53,12 @@ from pdnlp_tpu.serve.batcher import (  # noqa: F401
 from pdnlp_tpu.serve.controller import KnobSpec, ServeController  # noqa: F401
 from pdnlp_tpu.serve.decode import (  # noqa: F401
     DecodeBatcher, DecodeEngine, DecodeRouter, DecodeStream,
+    PagedDecodeEngine,
 )
 from pdnlp_tpu.serve.engine import InferenceEngine  # noqa: F401
+from pdnlp_tpu.serve.kvpage import (  # noqa: F401
+    KVPagesExhausted, PageAllocator, PrefixIndex,
+)
 from pdnlp_tpu.serve.fleet import (  # noqa: F401
     FleetRouter, ModelSpec, RolloutPlan, ShadowReport, parse_fleet_spec,
 )
@@ -73,9 +84,13 @@ __all__ = [
     "FleetMetrics",
     "FleetRouter",
     "InferenceEngine",
+    "KVPagesExhausted",
     "KnobSpec",
     "LoadShedError",
     "ModelSpec",
+    "PageAllocator",
+    "PagedDecodeEngine",
+    "PrefixIndex",
     "QueueFullError",
     "ReplicaFailedError",
     "ReplicaMetrics",

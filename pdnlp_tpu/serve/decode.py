@@ -73,6 +73,10 @@ from pdnlp_tpu.serve.batcher import (
     usable_buckets,
 )
 from pdnlp_tpu.serve.engine import InferenceEngine
+from pdnlp_tpu.serve.kvpage import (
+    INDEX_OWNER, KVPagesExhausted, PageAllocator, PrefixHit, PrefixIndex,
+    pages_needed,
+)
 from pdnlp_tpu.serve.metrics import DecodeMetrics, ReplicaMetrics
 from pdnlp_tpu.train import checkpoint as ckpt
 
@@ -146,21 +150,7 @@ class DecodeEngine(InferenceEngine):
         self.budget = KVBudget(getattr(args, "kv_hbm_mb", 0))
         requested = int(slots or getattr(args, "decode_slots", 8))
         self.token_bytes = decoder.kv_cache_bytes(cfg, 1, 1, self.kv_dtype)
-        slot_bytes = self.token_bytes * self.max_len
-        capped = self.budget.cap_slots(requested, slot_bytes)
-        # slots must tile the mesh's data axis; FLOOR so the cap holds
-        m = self.rows_multiple
-        slots_n = max(m, (capped // m) * m)
-        if slots_n * slot_bytes > (self.budget.budget_bytes or
-                                   slots_n * slot_bytes):
-            raise ValueError(
-                f"kv_hbm_mb cannot cover the {m}-slot mesh minimum "
-                f"({m * slot_bytes / 2**20:.1f} MB)")
-        if slots_n < requested:
-            print(f"[serve.decode] kv_hbm_mb caps decode slots "
-                  f"{requested} -> {slots_n} "
-                  f"({slot_bytes / 2**20:.1f} MB/slot)", file=sys.stderr)
-        self.slots = slots_n
+        self.slots = self._resolve_capacity(requested)
         self.prefill_rows = self.pad_rows(
             min(self.slots, int(prefill_rows or 8)))
         # prompt buckets: the serve bucket ladder capped at max_len, with
@@ -223,6 +213,58 @@ class DecodeEngine(InferenceEngine):
         self._jit_prefill = jax.jit(_prefill_fn)
         self._jit_insert = jax.jit(_insert_fn, donate_argnums=(0, 1))
         self._jit_decode = jax.jit(_decode_fn, donate_argnums=(2, 3))
+
+    #: layout marker — :class:`PagedDecodeEngine` flips it; the batcher
+    #: and router branch on behavior hooks, never on this flag, but
+    #: snapshots and bench reports name the layout through it
+    paged = False
+
+    def _resolve_capacity(self, requested: int) -> int:
+        """How many decode slots this engine runs: the ``--kv_hbm_mb``
+        budget caps the SLOT count here (the slot layout's capacity
+        unit); the paged engine overrides this to cap PAGES instead and
+        leave slots as pure batch rows."""
+        slot_bytes = self.token_bytes * self.max_len
+        capped = self.budget.cap_slots(requested, slot_bytes)
+        # slots must tile the mesh's data axis; FLOOR so the cap holds
+        m = self.rows_multiple
+        slots_n = max(m, (capped // m) * m)
+        if slots_n * slot_bytes > (self.budget.budget_bytes or
+                                   slots_n * slot_bytes):
+            raise ValueError(
+                f"kv_hbm_mb cannot cover the {m}-slot mesh minimum "
+                f"({m * slot_bytes / 2**20:.1f} MB)")
+        if slots_n < requested:
+            print(f"[serve.decode] kv_hbm_mb caps decode slots "
+                  f"{requested} -> {slots_n} "
+                  f"({slot_bytes / 2**20:.1f} MB/slot)", file=sys.stderr)
+        return slots_n
+
+    # ---------------------------------------------------- paging hooks
+    # The batcher drives BOTH layouts through these; on the slot layout
+    # they are no-ops (a slot IS the reservation), on the paged engine
+    # they are the allocator/prefix-index transaction per stream.
+    def peek_prefix(self, ids: Sequence[int]) -> Optional[str]:
+        """Admission-time prefix peek for the ``admit`` hop's
+        ``prefix_hit`` attr (None = layout has no prefix sharing)."""
+        return None
+
+    def attach_stream(self, slot: int, stream: "DecodeStream"):
+        """Reserve cache capacity for ``stream`` in ``slot``; returns a
+        claim descriptor (None on the slot layout — the slot claim
+        already IS the reservation)."""
+        return None
+
+    def detach_slot(self, slot: int) -> None:
+        """Release ``slot``'s cache reservation (no-op on slots)."""
+
+    def register_slot(self, slot: int, first_token: int) -> None:
+        """Index ``slot``'s freshly prefilled prompt for later sharing
+        (no-op on the slot layout)."""
+
+    def leak_check(self) -> Optional[Dict]:
+        """Allocator ledger audit (None on the slot layout)."""
+        return None
 
     # ----------------------------------------------------------- lifecycle
     def _alloc_cache(self) -> None:
@@ -519,12 +561,535 @@ class DecodeEngine(InferenceEngine):
         """JSON-ready KV/budget block for snapshots and ``/metrics``."""
         return {
             **self.budget.snapshot(),
+            "layout": "slots",
             "slots": int(self.slots),
             "max_len": int(self.max_len),
             "kv_dtype": ("int8" if self.kv_int8
                          else str(np.dtype(self.kv_dtype).name)),
             "cache_bytes": decoder.kv_cache_bytes(
                 self.cfg, self.slots, self.max_len, self.kv_dtype),
+        }
+
+
+class _PageClaim:
+    """One stream's page reservation (``PagedDecodeEngine`` slot state):
+    which kind of prefix hit it attached with, the continuation tokens it
+    covers, and what the prefill phase still owes it (nothing for a full
+    hit; the divergent suffix for a partial one)."""
+
+    __slots__ = ("owner", "kind", "tokens", "n_prompt_pages",
+                 "first_token", "suffix", "start")
+
+    def __init__(self, owner: str, kind: str, tokens: List[int],
+                 n_prompt_pages: int, first_token: Optional[int] = None,
+                 suffix: Optional[List[int]] = None, start: int = 0):
+        self.owner = owner
+        self.kind = kind                    # "cold" | "partial" | "full"
+        self.tokens = tokens                # prompt + emitted at attach
+        self.n_prompt_pages = n_prompt_pages
+        self.first_token = first_token      # full hits: stored token 0
+        self.suffix = suffix or []          # partial hits: the chunk
+        self.start = start                  # partial hits: suffix offset
+
+
+class PagedDecodeEngine(DecodeEngine):
+    """:class:`DecodeEngine` rebased onto the paged KV subsystem
+    (``serve.kvpage``): storage is ``[L, n_pages, page_sz, N, D]`` pages,
+    a per-stream page table drives the decode-step gather
+    (``models.decoder.paged_decode_step`` — still ONE fixed-shape jitted
+    program, pages donated across steps), and capacity is PAGES, not
+    slots: slots become pure decode-batch rows while ``--kv_hbm_mb`` caps
+    the page pool, so short streams stop paying for ``max_len`` stripes
+    and admitted concurrency scales with what streams actually use.
+
+    Prefix sharing rides the :class:`~pdnlp_tpu.serve.kvpage.PrefixIndex`:
+    a repeated prompt maps the indexed pages at refcount+1 and skips its
+    prefill entirely (**full hit** — the stored first token is emitted
+    straight from the index, so TTFT is bounded by one decode-step
+    latency); a shared-prefix prompt maps the matching full pages and
+    runs only the divergent suffix (**partial hit** —
+    ``paged_chunk_step``); copy-on-write duplicates a full hit's trailing
+    partial page before the stream writes into it.  Full pages are
+    immutable once written, which is what makes sharing safe without
+    copies.
+
+    Bitwise contract: a COLD paged stream runs the exact slot-engine
+    prefill program and a decode step that gathers to the same
+    ``[B, max_len]`` attention extent with identical values at every
+    visible position — token-identical continuations (the bench storm
+    gates paged-vs-slot equality stream by stream).  Shared-prefix
+    streams reuse K/V that is bitwise what their own prefill would have
+    produced (same program, same inputs), so greedy continuations match
+    the cold baseline the same way re-prefilled kill survivors always
+    have.
+
+    Pages replicate on a mesh (no ``NamedSharding`` axis): the page ->
+    stream mapping is dynamic, so there is no static batch axis to shard
+    the way slot stripes sharded; decode pools run per-replica meshes,
+    which keeps each pool device-local anyway."""
+
+    paged = True
+    #: fixed copy-on-write batch rows — one compiled ``copy_pages``
+    #: program per engine; unused rows ride the OOB sentinel
+    COW_ROWS = 4
+
+    def __init__(self, args, tokenizer=None, *, mesh=None, metrics=None,
+                 tracer=None, slots: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 prefill_rows: Optional[int] = None,
+                 page_sz: Optional[int] = None, prefix_share: bool = True,
+                 index_entries: int = 4096):
+        # consumed by _resolve_capacity / _alloc_cache, which the base
+        # constructor calls — set before super().__init__
+        self._req_page_sz = int(page_sz
+                                or getattr(args, "kv_page_sz", 0) or 16)
+        self.prefix_share = bool(prefix_share)
+        self._index_entries = int(index_entries)
+        super().__init__(args, tokenizer, mesh=mesh, metrics=metrics,
+                         tracer=tracer, slots=slots, max_len=max_len,
+                         buckets=buckets, prefill_rows=prefill_rows)
+        cfg = self.cfg
+        dtype = self.dtype
+        metrics_ref = self.metrics
+
+        if self.kv_int8:
+            def _pinsert_fn(pk, pv, ks_new, vs_new, flat_pos, ks, vs):
+                metrics_ref.retraces.inc()
+                return decoder.paged_insert(pk, pv, ks_new, vs_new,
+                                            flat_pos, kv_scales=(ks, vs))
+
+            def _pdecode_fn(params, head, pk, pv, tokens, table, pos,
+                            ks, vs):
+                metrics_ref.retraces.inc()
+                return decoder.paged_decode_step(
+                    params, head, cfg, tokens, pk, pv, table, pos,
+                    kv_scales=(ks, vs), dtype=dtype)
+
+            def _pchunk_fn(params, head, pk, pv, tokens, table, start,
+                           nreal, ks, vs):
+                metrics_ref.retraces.inc()
+                return decoder.paged_chunk_step(
+                    params, head, cfg, tokens, pk, pv, table, start,
+                    nreal, kv_scales=(ks, vs), dtype=dtype)
+        else:
+            def _pinsert_fn(pk, pv, ks_new, vs_new, flat_pos):
+                metrics_ref.retraces.inc()
+                return decoder.paged_insert(pk, pv, ks_new, vs_new,
+                                            flat_pos)
+
+            def _pdecode_fn(params, head, pk, pv, tokens, table, pos):
+                metrics_ref.retraces.inc()
+                return decoder.paged_decode_step(
+                    params, head, cfg, tokens, pk, pv, table, pos,
+                    dtype=dtype)
+
+            def _pchunk_fn(params, head, pk, pv, tokens, table, start,
+                           nreal):
+                metrics_ref.retraces.inc()
+                return decoder.paged_chunk_step(
+                    params, head, cfg, tokens, pk, pv, table, start,
+                    nreal, dtype=dtype)
+
+        def _pcow_fn(pk, pv, src, dst):
+            metrics_ref.retraces.inc()
+            return decoder.copy_pages(pk, pv, src, dst)
+
+        self._jit_pinsert = jax.jit(_pinsert_fn, donate_argnums=(0, 1))
+        self._jit_pdecode = jax.jit(_pdecode_fn, donate_argnums=(2, 3))
+        self._jit_pchunk = jax.jit(_pchunk_fn, donate_argnums=(2, 3))
+        self._jit_pcow = jax.jit(_pcow_fn, donate_argnums=(0, 1))
+
+    # --------------------------------------------------------- capacity
+    def _resolve_capacity(self, requested: int) -> int:
+        """Pages, not slots, are the budgeted unit: ``--kv_hbm_mb`` caps
+        the page pool (floor: one maximum-length stream) and the slot
+        count stays the requested batch width — admitted concurrency is
+        then bounded by what streams actually RESERVE, which is the
+        whole capacity story of paging."""
+        ps = max(1, min(self._req_page_sz, self.max_len))
+        self.page_sz = ps
+        self.pages_per_stream = pages_needed(self.max_len, ps)
+        self.page_bytes = self.token_bytes * ps
+        req_pages = int(requested) * self.pages_per_stream
+        self.n_pages = self.budget.cap_pages(
+            req_pages, self.page_bytes, min_pages=self.pages_per_stream)
+        if self.n_pages < req_pages:
+            print(f"[serve.decode] kv_hbm_mb caps KV pages "
+                  f"{req_pages} -> {self.n_pages} "
+                  f"({self.page_bytes / 2**20:.2f} MB/page, "
+                  f"{self.pages_per_stream}/stream worst case)",
+                  file=sys.stderr)
+        m = self.rows_multiple
+        return max(m, (int(requested) // m) * m)
+
+    def _alloc_cache(self) -> None:
+        """(Re)allocate the page pool + a fresh allocator/index/table —
+        construction and post-chaos :meth:`reset_cache`, never hot."""
+        cfg = self.cfg
+        shape = (cfg.num_layers, self.n_pages, self.page_sz,
+                 cfg.num_heads, cfg.head_dim)
+
+        def alloc():
+            # two SEPARATE buffers (donation aliasing — base note)
+            return jax.device_put(jnp.zeros(shape, self.kv_dtype))
+
+        self._cache_k = alloc()
+        self._cache_v = alloc()
+        self.allocator = PageAllocator(self.n_pages, self.page_sz,
+                                       self.page_bytes)
+        self.prefix = PrefixIndex(self.allocator, self.page_sz,
+                                  max_entries=self._index_entries)
+        if self.prefix_share:
+            self.allocator.reclaimer = self.prefix.evict
+        # per-slot page tables, host-resident and updated IN PLACE at
+        # attach/detach (never rebuilt per step — jaxlint R16 polices
+        # the rebuild-by-concatenate idiom); sentinel n_pages = dead row
+        self._table = np.full((self.slots, self.pages_per_stream),
+                              self.n_pages, np.int32)
+        self._slot_state: List[Optional[_PageClaim]] = [None] * self.slots
+        self._pending_cow: List[tuple] = []
+
+    # -------------------------------------------------------- admission
+    def check_stream_admissible(self, prompt_len: int,
+                                max_new: int) -> None:
+        """Base capacity rules, with the budgeted refusal in PAGE units
+        (the admission door the router quotes)."""
+        total = int(prompt_len) + int(max_new)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt_len > self.prompt_limit:
+            raise ValueError(
+                f"prompt of {prompt_len} tokens exceeds the "
+                f"{self.prompt_limit}-token prefill limit")
+        if total > self.max_len:
+            need = pages_needed(total, self.page_sz)
+            if self.budget.budget_bytes is not None:
+                from pdnlp_tpu.obs.memory import KVBudgetExceeded
+
+                raise KVBudgetExceeded(
+                    f"stream needs {need} KV pages ({total} positions, "
+                    f"{need * self.page_bytes / 2**20:.2f} MB) but a "
+                    f"stream's page table holds {self.pages_per_stream} "
+                    f"pages ({self.max_len} positions) under --kv_hbm_mb")
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the "
+                f"{self.max_len}-position page-table extent "
+                "(--decode_max_len)")
+
+    # ----------------------------------------------------- paging hooks
+    def peek_prefix(self, ids: Sequence[int]) -> Optional[str]:
+        if not self.prefix_share:
+            return None
+        return self.prefix.lookup(ids, count=False).kind
+
+    def attach_stream(self, slot: int, stream: "DecodeStream"):
+        """The per-stream allocator/index transaction: reserve EVERY
+        page the stream can ever touch (``ceil((prompt + max_new) /
+        page_sz)`` — full reservation, so decode never page-faults),
+        sharing the indexed prefix pages at refcount+1 and allocating
+        the rest fresh.  Raises
+        :class:`~pdnlp_tpu.serve.kvpage.KVPagesExhausted` (after index
+        eviction) when the pool cannot cover it — the batcher leaves the
+        stream queued and retries as live streams drain."""
+        tokens = list(stream.prompt_ids) + list(stream.emitted)
+        total = min(len(stream.prompt_ids) + stream.max_new_tokens,
+                    self.max_len)
+        ps = self.page_sz
+        need = pages_needed(total, ps)
+        owner = stream.rid
+        n_full = len(tokens) // ps
+        hit = (self.prefix.lookup(tokens) if self.prefix_share
+               else PrefixHit("miss"))
+        row = np.full((self.pages_per_stream,), self.n_pages, np.int32)
+        if hit.kind == "full" and hit.first_token is not None:
+            shared = [int(p) for p in hit.pages[:n_full]]
+            partial_src = (int(hit.pages[n_full])
+                           if len(hit.pages) > n_full else None)
+            # pin the shared pages (and the COW source) BEFORE the
+            # private alloc: the alloc's index eviction may drop the
+            # entries we just matched, and only the stream's own
+            # references keep their pages from returning to the free
+            # list mid-transaction
+            pin = shared + ([partial_src] if partial_src is not None
+                            else [])
+            self.allocator.share(pin, owner)
+            try:
+                private = self.allocator.alloc(need - n_full, owner)
+            except KVPagesExhausted:
+                self.allocator.release_owner(owner)
+                raise
+            row[:n_full] = shared
+            row[n_full:need] = private
+            if partial_src is not None and len(tokens) % ps and private:
+                self._pending_cow.append((partial_src, private[0]))
+                self.allocator.count_cow()
+            claim = _PageClaim(owner, "full", tokens,
+                               pages_needed(len(tokens), ps),
+                               first_token=int(hit.first_token))
+        else:
+            n_shared = len(hit.pages) if hit.kind == "partial" else 0
+            if n_shared and n_shared * ps >= len(tokens):
+                # keep at least one suffix token so the chunk forward
+                # has a last-token logit row to emit from
+                n_shared -= 1
+            if n_shared:
+                shared = [int(p) for p in hit.pages[:n_shared]]
+                self.allocator.share(shared, owner)
+                try:
+                    private = self.allocator.alloc(need - n_shared,
+                                                   owner)
+                except KVPagesExhausted:
+                    self.allocator.release_owner(owner)
+                    raise
+                row[:n_shared] = shared
+                row[n_shared:need] = private
+                claim = _PageClaim(owner, "partial", tokens,
+                                   pages_needed(len(tokens), ps),
+                                   suffix=tokens[n_shared * ps:],
+                                   start=n_shared * ps)
+            else:
+                private = self.allocator.alloc(need, owner)
+                row[:need] = private
+                claim = _PageClaim(owner, "cold", tokens,
+                                   pages_needed(len(tokens), ps))
+        self._table[slot] = row
+        self._slot_state[slot] = claim
+        return claim
+
+    def detach_slot(self, slot: int) -> None:
+        if not (0 <= slot < self.slots):
+            return
+        st = self._slot_state[slot]
+        if st is None:
+            return
+        held = set(int(p) for p in self._table[slot]
+                   if p < self.n_pages)
+        # a stream that finished before its COW flushed (EOS on the
+        # stored first token) must take its pending copies with it —
+        # both sides of each pair were pinned by this owner only
+        self._pending_cow = [(s, d) for (s, d) in self._pending_cow
+                             if d not in held and s not in held]
+        self._slot_state[slot] = None
+        self._table[slot, :] = self.n_pages
+        self.allocator.release_owner(st.owner)
+
+    def register_slot(self, slot: int, first_token: int) -> None:
+        if not self.prefix_share:
+            return
+        st = self._slot_state[slot] if 0 <= slot < self.slots else None
+        if st is None:
+            return
+        pages = [int(p) for p in self._table[slot][:st.n_prompt_pages]]
+        self.prefix.register(st.tokens, pages,
+                             first_token=int(first_token))
+
+    def leak_check(self) -> Dict:
+        """Allocator ledger audit + who still holds pages — the chaos
+        tests and the bench storm call this after drain (every non-index
+        owner must be gone, the refcount ledger must reconcile)."""
+        audit = self.allocator.leak_check()
+        audit["stream_owners"] = [o for o in self.allocator.owners()
+                                  if o != INDEX_OWNER]
+        audit["index_entries"] = len(self.prefix)
+        audit["ok"] = bool(audit["ok"]) and not audit["stream_owners"]
+        return audit
+
+    # ----------------------------------------------------------- forward
+    def _flush_cow(self, force: bool = False) -> None:
+        """Execute pending copy-on-write page copies (fixed
+        :data:`COW_ROWS`-row program; sentinel-padded).  Runs before any
+        program that could read or write the copied pages — the paged
+        prefill/chunk/decode entry points all call it first."""
+        if not self._pending_cow and not force:
+            return
+        P = self.n_pages
+        pend = self._pending_cow
+        self._pending_cow = []
+        rows = self.COW_ROWS
+        for i in range(0, max(len(pend), 1), rows):
+            batch = pend[i:i + rows]
+            src = np.full((rows,), P, np.int32)
+            dst = np.full((rows,), P, np.int32)
+            for j, (s, d) in enumerate(batch):
+                src[j] = s
+                dst[j] = d
+            key = ("cow", rows)
+            if key in self._seen_shapes:
+                self.metrics.cache_hits.inc()
+                span_name = "prefill"
+            else:
+                self.metrics.cache_misses.inc()
+                self._seen_shapes.add(key)
+                span_name = "compile"
+            with self.tracer.span(span_name, cow=True,
+                                  cow_pages=len(batch),
+                                  **self.span_attrs):
+                self._cache_k, self._cache_v = self._jit_pcow(
+                    self._cache_k, self._cache_v, src, dst)
+
+    def prefill_ids(self, id_lists: Sequence[Sequence[int]],
+                    slot_ids: Sequence[int],
+                    request_ids=None) -> np.ndarray:
+        """Cold-path prefill: the SAME bucketed causal forward as the
+        slot engine (bitwise-identical K/V for identical prompts — the
+        sharing contract rests on this), scattered into pages through
+        each claimed slot's table.  Filler rows and padding carry the
+        OOB flat sentinel, so they can never touch a live page."""
+        self._flush_cow()
+        n = len(id_lists)
+        assert n and n <= self.prefill_rows
+        bucket = pick_bucket(max(len(x) for x in id_lists),
+                             self.prefill_buckets)
+        rows = self.prefill_rows
+        ps = self.page_sz
+        oob = self.n_pages * ps
+        ids = np.zeros((rows, bucket), np.int32)
+        mask = np.zeros((rows, bucket), np.int32)
+        last = np.zeros((rows,), np.int32)
+        flat = np.full((rows, bucket), oob, np.int32)
+        for i, (x, s) in enumerate(zip(id_lists, slot_ids)):
+            ids[i, :len(x)] = x
+            mask[i, :len(x)] = 1
+            last[i] = len(x) - 1
+            if 0 <= s < self.slots and self._slot_state[s] is not None:
+                p = np.arange(len(x))
+                row = self._table[s]
+                flat[i, :len(x)] = row[p // ps] * ps + p % ps
+        key = (int(bucket), int(rows), "prefill")
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+            span_name = "prefill"
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+            span_name = "compile"
+        sharded = self._shard_batch({"ids": ids, "mask": mask})
+        tokens_in = int(mask.sum())
+        with self.tracer.span(span_name, seq=int(bucket), rows=int(rows),
+                              streams=int(n), prefill=True, paged=True,
+                              tokens=tokens_in, dtype=self.dtype_label,
+                              **self._telemetry_attrs(request_ids),
+                              **self.span_attrs):
+            logits, ks, vs = self._jit_prefill(
+                self.params, self.head, sharded["ids"], sharded["mask"],
+                last)
+            self._cache_k, self._cache_v = self._jit_pinsert(
+                self._cache_k, self._cache_v, ks, vs, flat,
+                *self._scale_args())
+            out = np.asarray(jax.device_get(logits))
+        return out[:n]
+
+    def prefill_chunk(self, suffixes: Sequence[Sequence[int]],
+                      slot_ids: Sequence[int], starts: Sequence[int],
+                      request_ids=None) -> np.ndarray:
+        """Partial-hit prefill: only the divergent SUFFIX runs
+        (``decoder.paged_chunk_step`` — the chunk attends to the shared
+        prefix pages through the table), bucketed over the same ladder
+        as prompts (compile key ``(bucket, rows, "chunk")``; warmup
+        pre-traces every bucket).  Returns each suffix's last-token
+        logits ``[n, vocab]``."""
+        self._flush_cow()
+        n = len(suffixes)
+        assert n and n <= self.prefill_rows
+        bucket = pick_bucket(max(len(x) for x in suffixes),
+                             self.prefill_buckets)
+        rows = self.prefill_rows
+        tokens = np.zeros((rows, bucket), np.int32)
+        start = np.zeros((rows,), np.int32)
+        nreal = np.zeros((rows,), np.int32)
+        table = np.full((rows, self.pages_per_stream), self.n_pages,
+                        np.int32)
+        for i, (x, s, st) in enumerate(zip(suffixes, slot_ids, starts)):
+            tokens[i, :len(x)] = x
+            start[i] = int(st)
+            nreal[i] = len(x)
+            if 0 <= s < self.slots:
+                table[i] = self._table[s]
+        key = (int(bucket), int(rows), "chunk")
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+            span_name = "prefill"
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+            span_name = "compile"
+        tokens_in = int(nreal.sum())
+        with self.tracer.span(span_name, seq=int(bucket), rows=int(rows),
+                              streams=int(n), prefill=True, paged=True,
+                              chunk=True, tokens=tokens_in,
+                              cached=int(sum(int(s) for s in starts)),
+                              dtype=self.dtype_label,
+                              **self._telemetry_attrs(request_ids),
+                              **self.span_attrs):
+            logits, self._cache_k, self._cache_v = self._jit_pchunk(
+                self.params, self.head, self._cache_k, self._cache_v,
+                tokens, table, start, nreal, *self._scale_args())
+            out = np.asarray(jax.device_get(logits))
+        return out[:n]
+
+    def decode_batch(self, tokens: np.ndarray, pos: np.ndarray,
+                     live: int, request_ids=None) -> np.ndarray:
+        """One fixed-shape decode step over the slot block, gathering
+        through the per-slot page tables.  Same ONE compile key
+        ``("decode", slots)`` as the slot layout — the table is data,
+        not shape, so paging cannot retrace."""
+        self._flush_cow()
+        key = ("decode", int(self.slots))
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+            span_name = "decode"
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+            span_name = "compile"
+        tok = np.asarray(tokens, np.int32).reshape(self.slots, 1)
+        p = np.clip(np.asarray(pos, np.int32), 0, self.max_len - 1)
+        with self.tracer.span(span_name, rows=int(self.slots),
+                              live=int(live), decode=True, paged=True,
+                              pages_live=self.allocator.used_pages,
+                              dtype=self.dtype_label,
+                              kv=("int8" if self.kv_int8
+                                  else np.dtype(self.kv_dtype).name),
+                              **self._telemetry_attrs(request_ids),
+                              **self.span_attrs):
+            logits, self._cache_k, self._cache_v = self._jit_pdecode(
+                self.params, self.head, self._cache_k, self._cache_v,
+                tok, jnp.asarray(self._table), p, *self._scale_args())
+            out = np.asarray(jax.device_get(logits))
+        return out
+
+    def warmup_decode(self) -> None:
+        """Pre-trace every reachable paged shape: per-bucket prefill +
+        paged insert, per-bucket suffix chunk, the ONE decode step, the
+        fixed COW copy, and the int8 calibration if pending."""
+        self._scale_args()
+        for b in self.prefill_buckets:
+            # OOB slot id: filler tables/flat sentinels — no live page
+            # is touched, exactly like the slot engine's warmup
+            self.prefill_ids([[self.tokenizer.cls_id] * b], [self.slots])
+            self.prefill_chunk([[self.tokenizer.cls_id] * b],
+                               [self.slots], [0])
+        self._flush_cow(force=True)
+        tok = np.zeros((self.slots,), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        self.decode_batch(tok, pos, live=0)
+
+    def kv_snapshot(self) -> Dict:
+        """Budget block + the paged story: allocator occupancy/free
+        depth/COW and the prefix index's hit accounting — the leaves the
+        Prometheus exporter flattens into gauges."""
+        return {
+            **self.budget.snapshot(),
+            "layout": "paged",
+            "slots": int(self.slots),
+            "max_len": int(self.max_len),
+            "kv_dtype": ("int8" if self.kv_int8
+                         else str(np.dtype(self.kv_dtype).name)),
+            "cache_bytes": decoder.kv_cache_bytes(
+                self.cfg, self.n_pages, self.page_sz, self.kv_dtype),
+            "pages": self.allocator.snapshot(),
+            "prefix": self.prefix.snapshot(),
         }
 
 
@@ -648,6 +1213,7 @@ class DecodeBatcher:
         self._poison: Optional[BaseException] = None
         self.dead = False
         self._worker: Optional[threading.Thread] = None
+        self._peak_live = 0  # high-water concurrent live streams
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "DecodeBatcher":
@@ -675,10 +1241,14 @@ class DecodeBatcher:
         leftovers = []
         with self._lock:
             leftovers += [s for s in self._waiting]
-            leftovers += [sl.stream for sl in self._slots if sl is not None]
+            still_live = [i for i, sl in enumerate(self._slots)
+                          if sl is not None]
+            leftovers += [self._slots[i].stream for i in still_live]
             self._waiting.clear()
             self._slots = [None] * self.engine.slots
             self._free = deque(range(self.engine.slots))
+        for i in still_live:
+            self.engine.detach_slot(i)  # pages back; leak_check clean
         for s in leftovers:
             if s._finish(RuntimeError("decode batcher stopped")):
                 record_hop(self.tracer, s.rid, "failed",
@@ -732,6 +1302,10 @@ class DecodeBatcher:
             record_hop(tr, stream.rid, "rejected",
                        reason=type(e).__name__)
             raise
+        # admission-time peek (no side effects: LRU untouched, no hit
+        # counters) — the admit hop advertises what sharing will buy
+        peek = self.engine.peek_prefix(ids)
+        extra = {} if peek is None else {"prefix_hit": peek}
         with self._lock:
             if self.dead or self._stop or self._worker is None:
                 raise RuntimeError("decode batcher is not running")
@@ -747,7 +1321,7 @@ class DecodeBatcher:
             self.metrics.waiting.set(len(self._waiting))
             record_hop(tr, stream.rid, "admit", streamed=True,
                        tokens=len(ids), max_new=max_new,
-                       replica=self.replica)
+                       replica=self.replica, **extra)
             self._wake.notify()
         return stream
 
@@ -780,6 +1354,21 @@ class DecodeBatcher:
                     while self._free and self._waiting:
                         slot = self._free.popleft()
                         stream = self._waiting.popleft()
+                        try:
+                            # paged engines reserve the stream's pages
+                            # here (sharing any indexed prefix); slot
+                            # engines no-op.  Exhausted pool = put both
+                            # back and wait for live streams to drain —
+                            # head-of-line order is preserved, and the
+                            # pool floor (>= one max-length stream)
+                            # guarantees an empty batch can always seat
+                            # the head, so this cannot deadlock.
+                            claim = self.engine.attach_stream(slot,
+                                                              stream)
+                        except KVPagesExhausted:
+                            self._free.appendleft(slot)
+                            self._waiting.appendleft(stream)
+                            break
                         freed = self._freed_at.pop(slot, None)
                         if freed is not None:
                             self.rmetrics.slot_reuse_ms.observe(
@@ -789,7 +1378,7 @@ class DecodeBatcher:
                         # claimed stream is already in _slots and the
                         # death path re-homes it instead of losing it
                         self._slots[slot] = _Slot(stream, 0, 0)
-                        claims.append((slot, stream))
+                        claims.append((slot, stream, claim))
                     self.metrics.waiting.set(len(self._waiting))
                     live = self._live_count()
                     if not claims and live == 0:
@@ -826,28 +1415,79 @@ class DecodeBatcher:
         self._waiting = keep
 
     def _prefill(self, claims: List[tuple]) -> None:
-        """Prefill claimed streams (chunked to the engine's fixed prefill
-        rows), emit each stream's FIRST token from the prefill logits,
-        and enter survivors into the decode batch."""
+        """Prefill claimed streams and emit each stream's FIRST token.
+
+        Paged claims split three ways by prefix-hit kind: **full** hits
+        run NO forward at all — the index stored the prompt's first
+        greedy token, so it is emitted right here (``prefills_total``
+        does not move: the bench's zero-prefill gate is structural);
+        **partial** hits forward only the divergent suffix
+        (:meth:`PagedDecodeEngine.prefill_chunk`); **cold** claims (and
+        every slot-engine claim, whose attach hook returns ``None``)
+        take the classic bucketed prefill, chunked to the engine's fixed
+        prefill rows.  Every stream still records a ``prefill`` hop —
+        the chain contract (no ``decode`` before ``prefill``) holds for
+        hits too, with ``prefix_hit``/``cached_tokens`` telling the
+        story."""
         rows = self.engine.prefill_rows
-        for i in range(0, len(claims), rows):
-            chunk = claims[i:i + rows]
-            prompts = [s.prompt_ids + s.emitted for _, s in chunk]
+        full = [c for c in claims
+                if c[2] is not None and c[2].kind == "full"]
+        part = [c for c in claims
+                if c[2] is not None and c[2].kind == "partial"]
+        cold = [c for c in claims
+                if c[2] is None or c[2].kind == "cold"]
+        now = time.monotonic()
+        for slot, stream, claim in full:
+            ntok = len(claim.tokens)
+            record_hop(self.tracer, stream.rid, "prefill", slot=slot,
+                       tokens_in=ntok, replica=self.replica,
+                       prefix_hit="full", cached_tokens=ntok)
+            self.metrics.ttft_ms.observe((now - stream.born) * 1e3)
+            # refresh the index entry's LRU standing (register of an
+            # existing key is a touch, not a re-insert)
+            self.engine.register_slot(slot, claim.first_token)
+            self._advance(slot, stream, int(claim.first_token), pos=ntok)
+        for i in range(0, len(cold), rows):
+            chunk = cold[i:i + rows]
+            prompts = [s.prompt_ids + s.emitted for _, s, _ in chunk]
             logits = self.engine.prefill_ids(
-                prompts, [slot for slot, _ in chunk],
-                request_ids=[s.rid for _, s in chunk])
+                prompts, [slot for slot, _, _ in chunk],
+                request_ids=[s.rid for _, s, _ in chunk])
             self.metrics.prefills_total.inc()
             self.metrics.prefill_tokens_total.inc(
                 sum(len(p) for p in prompts))
             now = time.monotonic()
-            for j, (slot, stream) in enumerate(chunk):
+            for j, (slot, stream, claim) in enumerate(chunk):
+                extra = {"prefix_hit": "miss"} if claim is not None else {}
                 record_hop(self.tracer, stream.rid, "prefill", slot=slot,
                            tokens_in=len(prompts[j]),
-                           replica=self.replica)
+                           replica=self.replica, **extra)
                 self.metrics.ttft_ms.observe((now - stream.born) * 1e3)
                 tok = int(np.argmax(logits[j]))
+                self.engine.register_slot(slot, tok)
                 self._advance(slot, stream, tok, pos=len(prompts[j]))
-            self._update_kv_gauge()
+        for i in range(0, len(part), rows):
+            chunk = part[i:i + rows]
+            suffixes = [c.suffix for _, _, c in chunk]
+            logits = self.engine.prefill_chunk(
+                suffixes, [slot for slot, _, _ in chunk],
+                [c.start for _, _, c in chunk],
+                request_ids=[s.rid for _, s, _ in chunk])
+            self.metrics.prefills_total.inc()
+            self.metrics.prefill_tokens_total.inc(
+                sum(len(x) for x in suffixes))
+            now = time.monotonic()
+            for j, (slot, stream, claim) in enumerate(chunk):
+                record_hop(self.tracer, stream.rid, "prefill", slot=slot,
+                           tokens_in=len(suffixes[j]),
+                           replica=self.replica, prefix_hit="partial",
+                           cached_tokens=claim.start)
+                self.metrics.ttft_ms.observe((now - stream.born) * 1e3)
+                tok = int(np.argmax(logits[j]))
+                self.engine.register_slot(slot, tok)
+                self._advance(slot, stream, tok,
+                              pos=len(claim.tokens))
+        self._update_kv_gauge()
 
     def _advance(self, slot: int, stream: DecodeStream, tok: int, *,
                  pos: int) -> None:
@@ -875,6 +1515,10 @@ class DecodeBatcher:
             else:
                 self._slots[slot] = _Slot(stream, pos, tok)
         if finish:
+            # release the stream's pages (refcount decrement — shared
+            # prefix pages stay live under the index / other streams);
+            # worker-only, so after the lock is fine
+            self.engine.detach_slot(slot)
             if stream._finish():
                 record_hop(self.tracer, stream.rid, "complete",
                            replica=self.replica, slot=slot,
@@ -922,6 +1566,13 @@ class DecodeBatcher:
         self.engine.budget.set_live(nbytes)
         self.metrics.kv_bytes_live.set(nbytes)
         self.metrics.kv_slots_live.set(live_slots)
+        if live_slots > self._peak_live:
+            self._peak_live = live_slots
+            self.metrics.peak_live_streams.set(live_slots)
+        if self.engine.paged:
+            alloc = self.engine.allocator
+            self.metrics.kv_pages_live.set(alloc.used_pages)
+            self.metrics.kv_pages_free.set(alloc.free_pages)
 
     def _die(self, error: BaseException) -> None:
         """Worker death: collect every stream this replica owes an answer
@@ -1049,3 +1700,45 @@ class DecodeRouter:
                          for b in self.batchers},
             "alive": len(self.alive()),
         }
+
+    def control_snapshot(self) -> Dict:
+        """Fleet-level paging view (the ops door next to
+        :meth:`snapshot`'s per-replica firehose): page occupancy, free
+        depth, COW/eviction counts and the prefix index's hit accounting,
+        aggregated across replicas — every numeric leaf flattens into a
+        Prometheus gauge via ``obs.prom.prometheus_lines``."""
+        reps: Dict[str, Dict] = {}
+        agg = {"pages_total": 0, "pages_live": 0, "free_depth": 0,
+               "cow_copies": 0, "evictions": 0, "alloc_failures": 0,
+               "hits_full": 0, "hits_partial": 0, "misses": 0,
+               "index_entries": 0}
+        for b in self.batchers:
+            kv = b.engine.kv_snapshot()
+            rep: Dict = {"alive": int(not b.dead), "load": b.load,
+                         "peak_live_streams": b._peak_live,
+                         "layout": kv.get("layout", "slots")}
+            pages = kv.get("pages")
+            prefix = kv.get("prefix")
+            if pages:
+                rep["pages"] = pages
+                agg["pages_total"] += pages["total_pages"]
+                agg["pages_live"] += pages["pages_live"]
+                agg["free_depth"] += pages["free_depth"]
+                agg["cow_copies"] += pages["cow_copies"]
+                agg["evictions"] += pages["evictions"]
+                agg["alloc_failures"] += pages["alloc_failures"]
+            if prefix:
+                rep["prefix"] = prefix
+                agg["hits_full"] += prefix["hits_full"]
+                agg["hits_partial"] += prefix["hits_partial"]
+                agg["misses"] += prefix["misses"]
+                agg["index_entries"] += prefix["entries"]
+            reps[str(b.replica)] = rep
+        looked = agg["hits_full"] + agg["hits_partial"] + agg["misses"]
+        agg["prefix_hit_rate"] = (
+            (agg["hits_full"] + agg["hits_partial"]) / looked
+            if looked else 0.0)
+        agg["page_occupancy"] = (agg["pages_live"] / agg["pages_total"]
+                                 if agg["pages_total"] else 0.0)
+        return {"alive": len(self.alive()), "pages": agg,
+                "replicas": reps}

@@ -175,7 +175,12 @@ class DecodeMetrics:
       (p99 is the streaming SLO ``bench.py --decode`` gates);
     - ``waiting`` — streams queued for a free slot;
     - ``kv_bytes_live`` / ``kv_slots_live`` — live KV occupancy (the
-      ``--kv_hbm_mb`` budget gauge on ``/metrics``).
+      ``--kv_hbm_mb`` budget gauge on ``/metrics``);
+    - ``kv_pages_live`` / ``kv_pages_free`` — paged layout only: page
+      pool occupancy and free-list depth (allocator/index detail rides
+      ``kv_snapshot()``/``control_snapshot()``);
+    - ``peak_live_streams`` — high-water concurrent live streams (the
+      admitted-concurrency headline the paged-vs-slot bench gates).
     """
 
     def __init__(self) -> None:
@@ -191,6 +196,9 @@ class DecodeMetrics:
         self.waiting = Gauge()
         self.kv_bytes_live = Gauge()
         self.kv_slots_live = Gauge()
+        self.kv_pages_live = Gauge()
+        self.kv_pages_free = Gauge()
+        self.peak_live_streams = Gauge()
 
     def snapshot(self) -> Dict:
         return {
@@ -206,6 +214,9 @@ class DecodeMetrics:
             "waiting": self.waiting.value,
             "kv_bytes_live": self.kv_bytes_live.value,
             "kv_slots_live": self.kv_slots_live.value,
+            "kv_pages_live": self.kv_pages_live.value,
+            "kv_pages_free": self.kv_pages_free.value,
+            "peak_live_streams": self.peak_live_streams.value,
         }
 
 

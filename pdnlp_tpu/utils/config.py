@@ -218,9 +218,25 @@ class Args:
                                                   # budget per decode
                                                   # engine (obs.memory.
                                                   # KVBudget): caps slots
-                                                  # at construction, loud
+                                                  # (slot layout) or pages
+                                                  # (paged layout) at
+                                                  # construction, loud
                                                   # refusal (never OOM) at
                                                   # admission; 0 = off
+    kv_layout: str = "paged"                      # decode KV cache layout:
+                                                  # paged (page allocator +
+                                                  # refcounted prefix
+                                                  # sharing, serve/kvpage.
+                                                  # py) | slots (the PR-14
+                                                  # per-stream stripes —
+                                                  # kept as the capacity/
+                                                  # parity baseline)
+    kv_page_sz: int = 16                          # paged layout: KV
+                                                  # positions per page (the
+                                                  # sharing granularity —
+                                                  # prefixes share in whole
+                                                  # pages, copy-on-write at
+                                                  # the divergence page)
     prefetch: int = 2                             # loader collation lookahead
     pipeline: str = "auto"                        # input pipeline (data/
                                                   # pipeline.py): auto|

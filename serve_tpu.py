@@ -290,13 +290,15 @@ def build_decode_pool(args: Args, replicas: int, *,
     """Generative serving pool: ``replicas`` :class:`DecodeEngine`\\ s —
     device-group meshes when the host has them, plain jit otherwise —
     behind a :class:`DecodeRouter` (1 replica included: the router is the
-    one submit/kill/snapshot surface either way).  Each engine owns a
-    preallocated slot KV cache (``--decode_slots`` × ``--decode_max_len``
+    one submit/kill/snapshot surface either way).  ``--kv_layout paged``
+    (the default) gives each engine a refcounted page pool with
+    cross-request prefix sharing; ``--kv_layout slots`` keeps the classic
+    preallocated slot cache (``--decode_slots`` × ``--decode_max_len``
     positions, ``--kv_dtype`` precision, gated by ``--kv_hbm_mb``)."""
     import jax
 
     from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, get_or_build_vocab
-    from pdnlp_tpu.serve import DecodeEngine, DecodeRouter
+    from pdnlp_tpu.serve import DecodeEngine, DecodeRouter, PagedDecodeEngine
 
     groups: list = [None] * replicas
     if use_mesh:
@@ -310,8 +312,11 @@ def build_decode_pool(args: Args, replicas: int, *,
             groups = [make_mesh(devices=devices[i * per:(i + 1) * per])
                       for i in range(replicas)]
     tok = WordPieceTokenizer(get_or_build_vocab(args))
-    engines = [DecodeEngine(args, tokenizer=tok, mesh=groups[i],
-                            buckets=buckets) for i in range(replicas)]
+    cls = (PagedDecodeEngine
+           if getattr(args, "kv_layout", "paged") != "slots"
+           else DecodeEngine)
+    engines = [cls(args, tokenizer=tok, mesh=groups[i],
+                   buckets=buckets) for i in range(replicas)]
     tracer = engines[0].tracer
     for e in engines[1:]:
         e.tracer = tracer  # one span/hop stream for the whole pool

@@ -37,3 +37,18 @@ def batch_loop(ids_batches, score_fn, cache_misses):
     for ids in ids_batches:
         cache_misses = jnp.append(cache_misses, score_fn(ids))
     return cache_misses
+
+
+def paged_decode(paged_decode_step, tok, pages_k, page_table, slot, row):
+    # the paged fix: a FIXED-extent table updated in place per attach —
+    # the decode step's shapes never grow
+    page_table = page_table.at[slot].set(row)
+    for _ in range(16):
+        tok, new = paged_decode_step(tok, pages_k, page_table)
+        pages_k = pages_k.at[:, slot].set(new)
+    return tok
+
+
+def build_table_once(rows):
+    # one-time page-table assembly OUTSIDE any decode loop
+    return jnp.stack(rows)

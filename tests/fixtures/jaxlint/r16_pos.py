@@ -24,3 +24,11 @@ def stacked_rebuild(generate_one, layers_kv, tok):
         tok, new = generate_one(tok, layers_kv)
         layers_kv = jnp.stack([layers_kv, new])
     return layers_kv
+
+
+def paged_decode(paged_decode_step, tok, pages_k, page_table):
+    for _ in range(16):
+        tok, new_page = paged_decode_step(tok, pages_k, page_table)
+        page_table = jnp.concatenate([page_table, new_page])
+        pages_k = jnp.stack([pages_k, new_page])
+    return tok

@@ -52,6 +52,18 @@ def prompts(n=6, seed=3, lo=4, hi=14, vocab=120):
     return [rng.integers(5, vocab, int(k)).tolist() for k in lens]
 
 
+@pytest.fixture(scope="module")
+def eng4(tok):
+    """ONE warmed default-geometry engine shared by the batcher-level
+    tests below: stream counters live on each (fresh) DecodeBatcher, not
+    the engine, so sharing the engine only shares its compiled jits —
+    which is exactly what keeps this file inside the tier-1 budget."""
+    eng = DecodeEngine(make_args(trace=True), tokenizer=tok, mesh=None,
+                       buckets=BUCKETS)
+    eng.warmup_decode()
+    return eng
+
+
 def run_streams(batcher, ps, max_new=8, eos=-1, timeout=120):
     batcher.eos_id = eos  # -1 = never stop early (deterministic lengths)
     streams = [batcher.submit_ids(p, max_new_tokens=max_new) for p in ps]
@@ -200,14 +212,11 @@ def test_engine_slot_reuse_is_bitwise_clean(tok):
 
 # ------------------------------------------------------- continuous batching
 
-def test_continuous_batching_slot_join_leave(tok):
+def test_continuous_batching_slot_join_leave(tok, eng4):
     """More streams than slots: finished streams leave, waiting streams
     claim freed slots between steps, every stream completes, and the
     freed-slot reuse + occupancy metrics actually record it."""
-    eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
-                       buckets=BUCKETS)
-    b = DecodeBatcher(eng).start()
-    b.warmup()
+    b = DecodeBatcher(eng4).start()
     ps = prompts(10, seed=5, vocab=tok.vocab_size)
     _, outs = run_streams(b, ps, max_new=6)
     assert all(len(o) == 6 for o in outs)
@@ -219,16 +228,13 @@ def test_continuous_batching_slot_join_leave(tok):
     b.stop()
 
 
-def test_batcher_tokens_deterministic_across_claim_orders(tok):
+def test_batcher_tokens_deterministic_across_claim_orders(tok, eng4):
     """The same prompt generates the same tokens whatever else shares
     the decode batch and in whatever order slots were claimed."""
     ps = prompts(5, seed=13, vocab=tok.vocab_size)
 
     def run(order):
-        eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
-                           buckets=BUCKETS)
-        b = DecodeBatcher(eng).start()
-        b.warmup()
+        b = DecodeBatcher(eng4).start()
         b.eos_id = -1
         streams = {i: b.submit_ids(ps[i], max_new_tokens=6) for i in order}
         res = {i: s.result(timeout=60) for i, s in streams.items()}
@@ -239,11 +245,8 @@ def test_batcher_tokens_deterministic_across_claim_orders(tok):
     assert all(a[i] == z[i] for i in range(5))
 
 
-def test_streaming_surface_and_detokenize(tok):
-    eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
-                       buckets=BUCKETS)
-    b = DecodeBatcher(eng).start()
-    b.warmup()
+def test_streaming_surface_and_detokenize(tok, eng4):
+    b = DecodeBatcher(eng4).start()
     b.eos_id = -1
     s = b.submit_ids([5, 6, 7], max_new_tokens=4)
     streamed = list(s.tokens(timeout=30))
@@ -275,21 +278,21 @@ def test_zero_retraces_50_mixed_streams(tok):
 
 # ------------------------------------------------------------------ int8 KV
 
-def test_kv_int8_argmax_parity(tok):
+def test_kv_int8_argmax_parity(tok, eng4):
     """int8 KV (calibrated per-channel scale tables) greedy-decodes the
     same token sequences as the fp32 cache."""
     ps = prompts(4, seed=1, vocab=tok.vocab_size)
 
-    def gen(**kw):
-        eng = DecodeEngine(make_args(**kw), tokenizer=tok, mesh=None,
-                           buckets=BUCKETS)
-        b = DecodeBatcher(eng).start()
+    def gen(engine):
+        b = DecodeBatcher(engine).start()
         b.warmup()
         _, outs = run_streams(b, ps, max_new=8)
         b.stop()
         return outs
 
-    assert gen() == gen(kv_dtype="int8")
+    int8_eng = DecodeEngine(make_args(kv_dtype="int8"), tokenizer=tok,
+                            mesh=None, buckets=BUCKETS)
+    assert gen(eng4) == gen(int8_eng)
 
 
 def test_kv_scales_offline_artifact_matches_self_calibration(tok, tmp_path):
@@ -329,10 +332,9 @@ def test_kv_scales_offline_artifact_matches_self_calibration(tok, tmp_path):
 
 # ---------------------------------------------------------------- KV budget
 
-def test_kv_budget_doors(tok):
+def test_kv_budget_doors(tok, eng4):
     args = make_args()
-    eng = DecodeEngine(args, tokenizer=tok, mesh=None, buckets=BUCKETS)
-    slot_mb = decoder.kv_cache_bytes(eng.cfg, 1, args.decode_max_len,
+    slot_mb = decoder.kv_cache_bytes(eng4.cfg, 1, args.decode_max_len,
                                      np.float32) / 2**20
     # (a) construction refusal: not even one slot fits
     with pytest.raises(KVBudgetExceeded):
@@ -364,10 +366,8 @@ def test_kv_budget_doors(tok):
     b.stop()
 
 
-def test_kv_budget_unbudgeted_plain_capacity_error(tok):
-    eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
-                       buckets=BUCKETS)
-    b = DecodeBatcher(eng).start()
+def test_kv_budget_unbudgeted_plain_capacity_error(tok, eng4):
+    b = DecodeBatcher(eng4).start()
     with pytest.raises(ValueError):
         b.submit_ids(list(range(5, 15)), max_new_tokens=10_000)
     b.stop()
@@ -387,12 +387,11 @@ def test_kv_budget_pure_policy():
 
 # ------------------------------------------------------------------ infill
 
-def test_infill_scoring_matches_bidirectional_mlm(tok):
+def test_infill_scoring_matches_bidirectional_mlm(tok, eng4):
     """The MLM-infilling scorer is exactly the bidirectional trunk + LM
     head — pinned bitwise against the direct model-level computation at
     the same padded shapes."""
-    eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
-                       buckets=BUCKETS)
+    eng = eng4
     ids = [5, 6, tok.unk_id, 8, 9]
     got = eng.infill_ids([ids])
     rows, bucket = eng.prefill_rows, 16
@@ -432,12 +431,10 @@ def test_streaming_chain_rules():
     assert chain_issues([ok[0], ok[1], ok[3]]) == []
 
 
-def test_decode_hops_carry_slot_step_tokens(tok):
-    args = make_args(trace=True)
-    eng = DecodeEngine(args, tokenizer=tok, mesh=None, buckets=BUCKETS)
+def test_decode_hops_carry_slot_step_tokens(tok, eng4):
+    eng = eng4
     assert eng.tracer.enabled
     b = DecodeBatcher(eng).start()
-    b.warmup()
     b.eos_id = -1
     s = b.submit_ids([5, 6, 7, 8], max_new_tokens=4)
     s.result(timeout=60)
@@ -477,8 +474,12 @@ def test_mid_decode_replica_kill_no_dup_no_loss(tok):
     _, refs = run_streams(rb, ps, max_new=48)
     rb.stop()
 
-    engines = [DecodeEngine(args, tokenizer=tok, mesh=None,
-                            buckets=BUCKETS) for _ in range(2)]
+    # the reference engine rides again as the to-be-killed replica: its
+    # jits are already compiled and the kill contract is about batcher +
+    # slot state, which a stopped batcher leaves clean
+    engines = [ref_eng,
+               DecodeEngine(args, tokenizer=tok, mesh=None,
+                            buckets=BUCKETS)]
     tracer = engines[0].tracer
     for e in engines[1:]:
         e.tracer = tracer
@@ -504,10 +505,8 @@ def test_mid_decode_replica_kill_no_dup_no_loss(tok):
     assert router.batchers[1].rmetrics.requeued_in.value >= 1
 
 
-def test_router_all_replicas_dead_fails_loudly(tok):
-    eng = DecodeEngine(make_args(), tokenizer=tok, mesh=None,
-                       buckets=BUCKETS)
-    router = DecodeRouter([eng]).start()
+def test_router_all_replicas_dead_fails_loudly(tok, eng4):
+    router = DecodeRouter([eng4]).start()
     router.warmup()
     router.kill(0)
     deadline = time.monotonic() + 10

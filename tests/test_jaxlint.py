@@ -364,16 +364,19 @@ def test_r15_hint_names_the_choke_point():
 
 def test_r16_kv_realloc_positive():
     # per-token cache concatenate rebuilds (9, 10), append-grown past
-    # (18), stack rebuild (25) — each in a loop dispatching a
-    # decode/generate-shaped call
+    # (18), stack rebuild (25), paged idiom: page-table rebuilt by
+    # concatenate (32) and page arrays re-stacked (33) — each in a loop
+    # dispatching a decode/generate-shaped call
     assert all_hits("r16_pos.py") == [("R16", 9), ("R16", 10),
-                                      ("R16", 18), ("R16", 25)]
+                                      ("R16", 18), ("R16", 25),
+                                      ("R16", 32), ("R16", 33)]
 
 
 def test_r16_kv_realloc_negative():
-    # .at[].set / dynamic_update_slice (the fix), one-time assembly
-    # outside decode loops, non-cache concatenation in a decode loop,
-    # and cache-NAMED appends in a non-decode loop all stay clean
+    # .at[].set / dynamic_update_slice (the fix, slot AND paged forms),
+    # one-time cache/table assembly outside decode loops, non-cache
+    # concatenation in a decode loop, and cache-NAMED appends in a
+    # non-decode loop all stay clean
     assert hits("r16_neg.py", "R16") == []
 
 
