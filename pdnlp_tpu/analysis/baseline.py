@@ -25,13 +25,29 @@ DEFAULT_BASELINE = os.path.join("results", "jaxlint_baseline.json")
 
 
 def write(findings: List[Finding], path: str) -> None:
+    # hand-written "reason" annotations (why a finding is grandfathered,
+    # not fixed) survive regeneration for findings still at the same
+    # (file, rule, line)
+    reasons: Dict[Tuple[str, str, int], str] = {}
+    if os.path.exists(path):
+        for e in load(path):
+            if "reason" in e:
+                reasons[(e["file"], e["rule"], e["line"])] = e["reason"]
+    entries = []
+    for f in findings:
+        d = f.to_dict()
+        key = (d["file"], d["rule"], d["line"])
+        if key in reasons:
+            d["reason"] = reasons[key]
+        entries.append(d)
     payload = {
         "version": 1,
         "tool": "lint_tpu.py",
         "note": ("per-(file,rule) violation counts ratchet tier-1; "
                  "regenerate with `python lint_tpu.py --write-baseline` "
-                 "after fixing findings"),
-        "findings": [f.to_dict() for f in findings],
+                 "after fixing findings; hand-add \"reason\" keys to "
+                 "grandfathered entries (kept across regeneration)"),
+        "findings": entries,
     }
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = path + ".tmp"

@@ -100,10 +100,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="*",
                    help="files/dirs to scan (default: the repo's standard "
                         "hazard surface)")
-    p.add_argument("--suite", choices=("tracing", "concurrency", "all"),
+    p.add_argument("--suite",
+                   choices=("tracing", "concurrency", "lifecycle", "all"),
                    default="all",
                    help="rule suite: the per-file tracing rules (R*), the "
-                        "whole-program concurrency analyses (T*), or both "
+                        "whole-program concurrency analyses (T*), the "
+                        "resource-lifecycle analyses (L*), or all "
                         "(default: %(default)s)")
     p.add_argument("--format", choices=("text", "json", "sarif"),
                    default=None,

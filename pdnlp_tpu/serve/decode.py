@@ -814,19 +814,24 @@ class PagedDecodeEngine(DecodeEngine):
             pin = shared + ([partial_src] if partial_src is not None
                             else [])
             self.allocator.share(pin, owner)
+            # ANY failure between the acquire and the page-table commit
+            # below must hand the reservation back, or the pages leak:
+            # KVPagesExhausted from the private alloc is just the common
+            # case (hence BaseException, not a named tuple of "expected"
+            # errors)
             try:
                 private = self.allocator.alloc(need - n_full, owner)
-            except KVPagesExhausted:
+                row[:n_full] = shared
+                row[n_full:need] = private
+                claim = _PageClaim(owner, "full", tokens,
+                                   pages_needed(len(tokens), ps),
+                                   first_token=int(hit.first_token))
+                if partial_src is not None and len(tokens) % ps and private:
+                    self._pending_cow.append((partial_src, private[0]))
+                    self.allocator.count_cow()
+            except BaseException:
                 self.allocator.release_owner(owner)
                 raise
-            row[:n_full] = shared
-            row[n_full:need] = private
-            if partial_src is not None and len(tokens) % ps and private:
-                self._pending_cow.append((partial_src, private[0]))
-                self.allocator.count_cow()
-            claim = _PageClaim(owner, "full", tokens,
-                               pages_needed(len(tokens), ps),
-                               first_token=int(hit.first_token))
         else:
             n_shared = len(hit.pages) if hit.kind == "partial" else 0
             if n_shared and n_shared * ps >= len(tokens):
@@ -839,20 +844,24 @@ class PagedDecodeEngine(DecodeEngine):
                 try:
                     private = self.allocator.alloc(need - n_shared,
                                                    owner)
-                except KVPagesExhausted:
+                    row[:n_shared] = shared
+                    row[n_shared:need] = private
+                    claim = _PageClaim(owner, "partial", tokens,
+                                       pages_needed(len(tokens), ps),
+                                       suffix=tokens[n_shared * ps:],
+                                       start=n_shared * ps)
+                except BaseException:
                     self.allocator.release_owner(owner)
                     raise
-                row[:n_shared] = shared
-                row[n_shared:need] = private
-                claim = _PageClaim(owner, "partial", tokens,
-                                   pages_needed(len(tokens), ps),
-                                   suffix=tokens[n_shared * ps:],
-                                   start=n_shared * ps)
             else:
                 private = self.allocator.alloc(need, owner)
-                row[:need] = private
-                claim = _PageClaim(owner, "cold", tokens,
-                                   pages_needed(len(tokens), ps))
+                try:
+                    row[:need] = private
+                    claim = _PageClaim(owner, "cold", tokens,
+                                       pages_needed(len(tokens), ps))
+                except BaseException:
+                    self.allocator.release_owner(owner)
+                    raise
         self._table[slot] = row
         self._slot_state[slot] = claim
         return claim

@@ -188,6 +188,40 @@ class PageAllocator:
             pages = [p for p, c in owned.items() for _ in range(c)]
             return self._decref_locked(pages, owner)
 
+    def transfer(self, pages: Sequence[int], from_owner: str,
+                 to_owner: str) -> None:
+        """Re-ledger one ``from_owner`` reference per page (with
+        multiplicity) onto ``to_owner``, atomically.  Total refcounts
+        never move, so no page can transit the free list mid-handoff —
+        the blip a ``share``-then-``release`` pair would open if the
+        source dropped to refcount 0 between the calls.  This is the
+        sanctioned ownership-handoff idiom (the lifecycle lint's L1
+        recognizes it as a release on ``from_owner``'s side).  The
+        whole batch is validated before any page moves: raises
+        :class:`AssertionError` (and changes nothing) when
+        ``from_owner`` does not hold every requested page."""
+        with self._lock:
+            if from_owner == to_owner:
+                return
+            need = Counter(int(p) for p in pages)
+            if not need:
+                return
+            owned = self._owned.get(from_owner)
+            for p, c in need.items():
+                held = owned.get(p, 0) if owned else 0
+                if held < c:
+                    raise AssertionError(
+                        f"transfer of page {p} x{c} not held by owner "
+                        f"{from_owner!r} (holds {held})")
+            dst = self._owned.setdefault(to_owner, Counter())
+            for p, c in need.items():
+                owned[p] -= c
+                if owned[p] == 0:
+                    del owned[p]
+                dst[p] += c
+            if not owned:
+                del self._owned[from_owner]
+
     # ------------------------------------------------------------- metering
     @property
     def free_pages(self) -> int:

@@ -2,8 +2,8 @@
 # jaxlint gate — the documented pre-push step (and what bench.py's smokes
 # re-check before burning accelerator time).
 #
-# Runs BOTH suites (tracing R* + concurrency T*) over the repo's standard
-# hazard surface, enforces the committed count-based baseline
+# Runs ALL suites (tracing R* + concurrency T* + lifecycle L*) over the
+# repo's standard hazard surface, enforces the committed count-based baseline
 # (results/jaxlint_baseline.json: new findings fail, fixed findings only
 # ever loosen the gate), and always leaves a SARIF artifact at
 # results/jaxlint.sarif for CI annotation / editor ingestion — findings

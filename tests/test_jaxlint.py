@@ -682,9 +682,11 @@ def test_findings_carry_exact_location_and_hint():
 
 
 def test_rule_registry_complete():
-    # the registry sorts by id STRING (R10..R16 between R1 and R2; the
-    # concurrency suite's T1-T3 after the R's)
-    assert list(all_rules()) == ["R1", "R10", "R11", "R12", "R13", "R14",
+    # the registry sorts by id STRING (the lifecycle suite's L1-L4
+    # before the R's; R10..R16 between R1 and R2; the concurrency
+    # suite's T1-T3 after the R's)
+    assert list(all_rules()) == ["L1", "L2", "L3", "L4",
+                                 "R1", "R10", "R11", "R12", "R13", "R14",
                                  "R15", "R16", "R2", "R3", "R4", "R5",
                                  "R6", "R7", "R8", "R9",
                                  "T1", "T2", "T3"]
@@ -693,6 +695,8 @@ def test_rule_registry_complete():
                if rid.startswith("T"))
     assert all(s == "tracing" for rid, s in suites.items()
                if rid.startswith("R"))
+    assert all(s == "lifecycle" for rid, s in suites.items()
+               if rid.startswith("L"))
 
 
 # -------------------------------------------------------------- suppressions

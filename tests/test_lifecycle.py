@@ -1,0 +1,347 @@
+"""Lifecycle suite (L1-L4) tier-1 tests: CFG exception edges, per-rule
+fixtures, the seeded-fault acceptance pin, interprocedural obligation
+summaries, the allocator's transfer() handoff primitive, the parse
+cache, and the whole-repo gate (clean + inside the wall-time budget).
+
+Like the jaxlint suite, everything here is pure ``ast`` — no jax import,
+millisecond-fast per rule; only the whole-repo scans touch real files.
+"""
+import ast
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pdnlp_tpu.analysis import analyze_paths, baseline, default_paths  # noqa: E402
+from pdnlp_tpu.analysis.cfg import (  # noqa: E402
+    RAISE_EXIT, RETURN_EXIT, build_cfg,
+)
+from pdnlp_tpu.analysis.core import ProgramInfo, parse_module  # noqa: E402
+from pdnlp_tpu.analysis.lifecycle.model import get_lifecycle  # noqa: E402
+from pdnlp_tpu.serve.kvpage import PageAllocator  # noqa: E402
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "jaxlint")
+
+
+def hits(name, rule_id=None):
+    path = os.path.join(FIXTURES, name)
+    found = analyze_paths([path], root=REPO)
+    if rule_id:
+        found = [f for f in found if f.rule_id == rule_id]
+    return [(f.rule_id, f.line) for f in found]
+
+
+def all_hits(name):
+    path = os.path.join(FIXTURES, name)
+    return [(f.rule_id, f.line)
+            for f in analyze_paths([path], root=REPO)]
+
+
+def finding(name, rule_id, line):
+    path = os.path.join(FIXTURES, name)
+    return [f for f in analyze_paths([path], root=REPO)
+            if f.rule_id == rule_id and f.line == line][0]
+
+
+# ------------------------------------------------------------------ the CFG
+
+def _fn(src):
+    return ast.parse(textwrap.dedent(src)).body[0]
+
+
+def _expr_node(cfg, callee):
+    for nid, s in cfg.stmts.items():
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call) \
+                and isinstance(s.value.func, ast.Name) \
+                and s.value.func.id == callee:
+            return nid
+    raise AssertionError(f"no Expr node calling {callee}")
+
+
+def test_cfg_narrow_handler_lets_exceptions_escape():
+    fn = _fn("""
+        def f(a):
+            try:
+                work(a)
+            except ValueError:
+                cleanup(a)
+            done(a)
+    """)
+    cfg = build_cfg(fn)
+    work = _expr_node(cfg, "work")
+    blocked = {_expr_node(cfg, "cleanup"), _expr_node(cfg, "done")}
+    # `except ValueError` does not cover an arbitrary raise: the exc
+    # edge escapes past the handlers to RAISE_EXIT
+    assert RAISE_EXIT in cfg.reachable_exits([work], blocked)
+
+
+def test_cfg_broad_handler_contains_exceptions():
+    fn = _fn("""
+        def f(a):
+            try:
+                work(a)
+            except Exception:
+                cleanup(a)
+            done(a)
+    """)
+    cfg = build_cfg(fn)
+    work = _expr_node(cfg, "work")
+    blocked = {_expr_node(cfg, "cleanup"), _expr_node(cfg, "done")}
+    assert cfg.reachable_exits([work], blocked) == set()
+
+
+def test_cfg_finally_routes_every_exit_through_the_release():
+    fn = _fn("""
+        def f(a):
+            acquire(a)
+            try:
+                if a:
+                    return early(a)
+                work(a)
+            finally:
+                release(a)
+    """)
+    cfg = build_cfg(fn)
+    acq = _expr_node(cfg, "acquire")
+    rel = _expr_node(cfg, "release")
+    # normal completion, the return, AND the exception edge all pass
+    # through the finally body: blocking the release blocks every exit
+    assert cfg.reachable_exits(cfg.step_successors(acq), {rel}) == set()
+    # ...and without the block, both exits are live
+    exits = cfg.reachable_exits(cfg.step_successors(acq), set())
+    assert exits == {RETURN_EXIT, RAISE_EXIT}
+
+
+# ------------------------------------------------------------ per-rule exact
+
+def test_l1_leaked_acquire_positive():
+    # exception window (14), bare return (19), leaked share pin (26),
+    # semaphore (31), inherited helper obligation (39), tmpdir (45),
+    # standby exc-only (51)
+    assert all_hits("l1_pos.py") == [
+        ("L1", 14), ("L1", 19), ("L1", 26), ("L1", 31), ("L1", 39),
+        ("L1", 45), ("L1", 51)]
+
+
+def test_l1_leaked_acquire_negative():
+    # broad handler, try/finally, commit-before-raise, committed at
+    # birth, store mutator, return-of-resource, helper releases,
+    # transfer(), the attach_stream shape, with-managed acquires
+    assert hits("l1_neg.py", "L1") == []
+
+
+def test_l1_seeded_fault_reports_the_exact_leak_line():
+    """THE acceptance pin: a raise injected between the alloc and the
+    page-table commit — L1 names the alloc line and the fault line."""
+    assert all_hits("l1_fault.py") == [("L1", 12)]
+    f = finding("l1_fault.py", "L1", 12)
+    assert "exception edge" in f.message
+    assert "escape at line 14" in f.message  # the injected raise
+
+
+def test_l1_messages_cite_kind_and_escape_site():
+    f = finding("l1_pos.py", "L1", 19)
+    assert "kv-pages" in f.message and "return path" in f.message
+    assert "escape at line 21" in f.message
+    f = finding("l1_pos.py", "L1", 51)
+    assert "standby" in f.message and "exception edge" in f.message
+
+
+def test_l2_terminal_coverage_positive():
+    # orphaned admit (6: exception escape with no terminal), double
+    # terminal (15: complete at 14 then failed, unguarded)
+    assert all_hits("l2_pos.py") == [("L2", 6), ("L2", 15)]
+    f = finding("l2_pos.py", "L2", 15)
+    assert "'complete' at line 14" in f.message
+
+
+def test_l2_terminal_coverage_negative():
+    # except-handler terminal + re-raise, worker-owned terminal after a
+    # normal return, _finish/_complete first-wins guards, distinct rids,
+    # and a loop over OTHER streams' terminals
+    assert hits("l2_neg.py", "L2") == []
+
+
+def test_l2_terminal_hops_pinned_to_runtime():
+    from pdnlp_tpu.analysis.lifecycle.l2_terminal_coverage import (
+        TERMINAL_HOPS as lint_hops,
+    )
+    from pdnlp_tpu.obs.request import TERMINAL_HOPS as runtime_hops
+    assert lint_hops == runtime_hops
+
+
+def test_l3_non_atomic_publish_positive():
+    # manifest write (6), one-hop assigned best.json (12), bare handle
+    # on a .msgpack (17)
+    assert all_hits("l3_pos.py") == [("L3", 6), ("L3", 12), ("L3", 17)]
+
+
+def test_l3_non_atomic_publish_negative():
+    # tmp+fsync+os.replace, the sanctioned writer itself, unwatched
+    # paths, and reads
+    assert hits("l3_neg.py", "L3") == []
+
+
+def test_l4_unbalanced_manual_lock_positive():
+    # exception before release (11), early return (16), bare lock
+    # parameter classified by name hint (25)
+    assert all_hits("l4_pos.py") == [("L4", 11), ("L4", 16), ("L4", 25)]
+
+
+def test_l4_unbalanced_manual_lock_negative():
+    # with-managed, release in finally, conditional acquire (out of
+    # scope), straight-line acquire/release
+    assert hits("l4_neg.py", "L4") == []
+
+
+def test_lifecycle_suppression_honored():
+    # the commented acquire is silenced; the bare one still fires
+    assert all_hits("l_suppressed.py") == [("L4", 15)]
+
+
+def test_lifecycle_suite_partition():
+    p = os.path.join(FIXTURES, "l4_pos.py")
+    assert analyze_paths([p], root=REPO, suite="tracing") == []
+    assert analyze_paths([p], root=REPO, suite="concurrency") == []
+    got = analyze_paths([p], root=REPO, suite="lifecycle")
+    assert {f.rule_id for f in got} == {"L4"}
+
+
+# ------------------------------------------------ interprocedural summaries
+
+def test_helper_summaries_carry_obligations_both_directions():
+    pos = parse_module(os.path.join(FIXTURES, "l1_pos.py"), "l1_pos.py")
+    neg = parse_module(os.path.join(FIXTURES, "l1_neg.py"), "l1_neg.py")
+    model = get_lifecycle(ProgramInfo([pos]))
+    # acquire-returning helper: call sites inherit the obligation
+    assert model.funcs["m:l1_pos.Engine._reserve"].returns_kind \
+        == "kv-pages"
+    model = get_lifecycle(ProgramInfo([neg]))
+    # releasing helper: passing the resource to it discharges at the
+    # call site (the owner-id argument is marked too — conservative,
+    # and harmless: discharge still requires the CALLER's arg to
+    # mention a tracked alias)
+    assert "pages" in \
+        model.funcs["m:l1_neg.Engine._dispose"].released_params
+
+
+# --------------------------------------------------- the transfer primitive
+
+def test_transfer_moves_ownership_without_a_refcount_blip():
+    a = PageAllocator(8, 16)
+    pages = a.alloc(3, "src")
+    a.transfer(pages, "src", "dst")
+    assert "src" not in a.owners() and "dst" in a.owners()
+    # refcounts moved intact: dst's release frees all three
+    assert a.release(pages, "dst") == 3
+    assert a.free_pages == 8
+    assert a.leak_check()["leaked_pages"] == 0
+
+
+def test_transfer_validates_the_whole_batch_before_moving_anything():
+    a = PageAllocator(8, 16)
+    pages = a.alloc(2, "src")
+    a.share(pages, "other")
+    a.transfer(pages, "src", "dst")  # moves src's refs only
+    with pytest.raises(AssertionError):
+        a.transfer(pages, "src", "dst")  # src no longer holds them
+    with pytest.raises(AssertionError):
+        a.transfer([pages[0], pages[0]], "dst", "x")  # x2 > held x1
+    # the failed transfers changed nothing: both ledgers still release
+    assert a.release(pages, "dst") == 0  # other still holds
+    assert a.release(pages, "other") == 2
+    assert a.free_pages == 8
+
+
+def test_transfer_same_owner_and_empty_are_noops():
+    a = PageAllocator(4, 16)
+    pages = a.alloc(2, "o")
+    a.transfer(pages, "o", "o")
+    a.transfer([], "o", "p")
+    assert a.owners() == ["o"]
+    assert a.release_owner("o") == 2
+
+
+# --------------------------------------------------------- the parse cache
+
+def test_parse_cache_hits_on_unchanged_files(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text("x = 1\n")
+    m1 = parse_module(str(p), "m.py")
+    assert parse_module(str(p), "m.py") is m1
+    time.sleep(0.01)
+    p.write_text("x = 1234\n")  # size + mtime change -> reparse
+    assert parse_module(str(p), "m.py") is not m1
+
+
+# ----------------------------------------------------- ratchet + exit codes
+
+def test_lifecycle_baseline_ratchet_and_cli_exit_codes(tmp_path):
+    tree = tmp_path / "t"
+    tree.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "l4_pos.py"), tree / "old.py")
+    env = {**os.environ, "PYTHONPATH": REPO}
+    base = tmp_path / "base.json"
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "lint_tpu.py"),
+             "--baseline", str(base), *extra, str(tree)],
+            capture_output=True, text=True, env=env, cwd=str(tmp_path))
+
+    # record the debt, then the lifecycle suite runs clean against it
+    assert run("--write-baseline").returncode == 0
+    assert run("--suite", "lifecycle").returncode == 0
+    # a fresh leak IS new and fails the gate
+    (tree / "fresh.py").write_text(
+        "import threading\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def f(self, job):\n"
+        "        self._lock.acquire()\n"
+        "        handle(job)\n"
+        "        self._lock.release()\n")
+    out = run("--suite", "lifecycle")
+    assert out.returncode == 1
+    assert "fresh.py:9" in out.stdout and "L4" in out.stdout
+    # a partial scan must never become THE baseline
+    refused = run("--suite", "lifecycle", "--write-baseline")
+    assert refused.returncode == 2
+    assert "refusing" in refused.stderr
+
+
+# -------------------------------------------------------- whole-repo gates
+
+def test_repo_surface_lifecycle_clean():
+    """The suite's own acceptance pin: zero lifecycle findings on the
+    repo's real hazard surface (every real finding was fixed in-tree or
+    suppressed in place with a reason — nothing grandfathered)."""
+    found = analyze_paths(default_paths(REPO), root=REPO,
+                          suite="lifecycle")
+    assert found == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule_id} {f.message}" for f in found)
+
+
+def test_whole_repo_all_suites_within_wall_time_budget():
+    """Lint self-performance guard: the full three-suite scan over the
+    repo surface (what scripts/lint_gate.sh and bench's refusal gate
+    run) must stay interactive.  Budget is ~4x the current cost so the
+    assert catches an accidental O(n^2) regression, not CI jitter."""
+    t0 = time.perf_counter()
+    findings = analyze_paths(default_paths(REPO), root=REPO, suite="all")
+    dt = time.perf_counter() - t0
+    assert dt < 60.0, f"--suite all took {dt:.1f}s (budget 60s)"
+    # and the scan is coherent vs the committed baseline
+    base = baseline.load(os.path.join(REPO, "results",
+                                      "jaxlint_baseline.json"))
+    new, _fixed = baseline.compare(findings, base)
+    assert new == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule_id} {f.message}" for f in new)
