@@ -20,4 +20,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r14_quadratic_bias,
     r15_unrecorded_traffic_shift,
     r16_kv_realloc,
+    r17_spec_retrace,
 )

@@ -1,0 +1,125 @@
+"""R17 — speculation dispatch whose shape follows runtime k.
+
+Speculative decoding (``pdnlp_tpu.serve.decode`` — draft-k / verify-1)
+stays retrace-free by CONSTRUCTION: the drafter runs k fixed-shape
+``[rows, 1]`` decode steps and the primary scores all k+1 positions in
+ONE prefill-shaped ``verify`` program of fixed ``[slots, k+1]`` extent —
+the number of REAL positions rides a data argument (``nreal``), never
+the array shape.  The tempting spelling inverts that::
+
+    for _ in range(max_new):
+        window = draft(params, tok, kv)
+        logits = verify_ids(params, window[:, : a + 1], kv)   # <- R17
+        a = accept_len(logits, window)
+
+Slicing the verify window to the runtime accepted length (or the draft
+window to an adaptive ``k``) hands jit a DIFFERENT shape whenever the
+acceptance changes — under greedy speculation that is nearly every
+round, so the "fast path" compiles per round and serves slower than the
+primary-only loop it was meant to beat.  The fix is the engine's: a
+fixed full-width dispatch with the real length as data (masked inside
+the program), one compile per configured k.
+
+Heuristic, per lexical ``for``/``while`` loop (R16's decode-loop
+machinery): the loop is DECODE-SHAPED — it dispatches a call whose
+name's last segment contains ``decode``/``prefill``/``generate``/
+``draft``/``verify``/``speculat`` or matches the jitted-step convention
+(``*step``/``*step_fn``) — and the body dispatches a SPECULATION call
+(last segment contains ``draft``/``verify``/``speculat``) with an
+argument containing a subscript SLICE whose bound is not a compile-time
+constant (any identifier in the ``lower``/``upper``/``step`` subtree:
+``window[:, : a + 1]``, ``tok[:, :k]``).  The finding lands on the
+speculation call.  Full-width dispatch, literal-bound slices
+(``window[:, :5]``), runtime lengths passed as data arguments, and
+variable slices outside a decode loop never match.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from pdnlp_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, dotted_name, is_step_call, loop_body_calls,
+    register,
+)
+
+_DECODE_CALL_RE = re.compile(
+    r"(decode|prefill|generate|draft|verify|speculat)", re.I)
+_SPEC_CALL_RE = re.compile(r"(draft|verify|speculat)", re.I)
+
+
+@register
+class PerKRetraceInSpeculation(Rule):
+    rule_id = "R17"
+    name = "per-k-retrace-in-speculation"
+    hint = ("dispatch the draft/verify program at its FULL fixed width "
+            "([slots, k+1] for one configured k) and pass the runtime "
+            "accepted/real length as a data argument the program masks "
+            "on (pdnlp_tpu.serve.decode PagedDecodeEngine.verify_ids / "
+            "paged_verify_step are the engine forms) — slicing the "
+            "window to a runtime length inside the decode loop hands "
+            "jit a new shape nearly every round, so the speculative "
+            "path recompiles per round instead of once per configured k")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not self._relevant(mod):
+            return
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            calls = loop_body_calls(mod, loop)
+            if not any(self._is_decode_dispatch(c) for c in calls):
+                continue
+            for c in calls:
+                if self._is_spec_dispatch(c) and self._has_runtime_slice(c):
+                    yield self.finding(
+                        mod, c,
+                        "speculation dispatch sliced to a runtime length "
+                        "inside a decode loop — every distinct accepted "
+                        "length (or adapted k) is a new program shape, so "
+                        "the verify/draft step retraces per round instead "
+                        "of compiling once per configured k with the real "
+                        "length passed as masked data")
+
+    @staticmethod
+    def _relevant(mod: ModuleInfo) -> bool:
+        return "jax" in mod.aliases or any(
+            a.startswith("jax") for a in mod.aliases.values())
+
+    @staticmethod
+    def _is_decode_dispatch(call: ast.Call) -> bool:
+        name = dotted_name(call.func)
+        if not name:
+            return False
+        last = name.split(".")[-1]
+        return bool(_DECODE_CALL_RE.search(last)) or is_step_call(call)
+
+    @staticmethod
+    def _is_spec_dispatch(call: ast.Call) -> bool:
+        name = dotted_name(call.func)
+        if not name:
+            return False
+        return bool(_SPEC_CALL_RE.search(name.split(".")[-1]))
+
+    @staticmethod
+    def _has_runtime_slice(call: ast.Call) -> bool:
+        """Any argument whose subtree subscripts with a Slice whose
+        lower/upper/step contains an identifier — a bound only runtime
+        knows, i.e. a shape that varies with it."""
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for node in ast.walk(arg):
+                if not isinstance(node, ast.Subscript):
+                    continue
+                sl = node.slice
+                parts = [sl] if isinstance(sl, ast.Slice) else [
+                    d for d in getattr(sl, "elts", [])
+                    if isinstance(d, ast.Slice)]
+                for dim in parts:
+                    for bound in (dim.lower, dim.upper, dim.step):
+                        if bound is None:
+                            continue
+                        if any(isinstance(n, ast.Name)
+                               for n in ast.walk(bound)):
+                            return True
+        return False

@@ -478,6 +478,90 @@ def paged_chunk_step(params: Params, head: Params, cfg: BertConfig,
     return (logits, pk.reshape(pages_k.shape), pv.reshape(pages_v.shape))
 
 
+def paged_verify_step(params: Params, head: Params, cfg: BertConfig,
+                      tokens: jax.Array,      # [B, K1] int32 (spec window)
+                      pages_k: jax.Array,     # [L, P, page_sz, N, D]
+                      pages_v: jax.Array,
+                      page_table: jax.Array,  # [B, MP] int32 (sentinel P)
+                      start: jax.Array,       # [B] abs pos of tokens[:,0]
+                      nreal: jax.Array,       # [B] real window lengths
+                      *, kv_scales: Optional[Tuple[jax.Array,
+                                                   jax.Array]] = None,
+                      dtype=jnp.float32, unroll=True
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative verify: score the pending token plus k drafted tokens
+    in ONE prefill-shaped call against the primary's paged cache.  The
+    body is :func:`paged_chunk_step` verbatim — same per-query linear
+    visibility, same write-through-the-table K/V commit — but the LM
+    head runs over EVERY window position, returning ``[B, K1, vocab]``
+    fp32 so the caller can take the greedy target at each draft offset.
+    K/V for the whole window is written eagerly; rejected positions stay
+    in the cache as stale entries that no later query can see (the
+    visibility mask is position-based) and the next round overwrites
+    them in place.  Rows with ``nreal == 0`` are filler whose writes
+    land OOB (sentinel table rows) and whose logits the caller
+    discards."""
+    _check_dense_trunk(params["layers"])
+    L, P, ps = pages_k.shape[0], pages_k.shape[1], pages_k.shape[2]
+    tail = pages_k.shape[3:]
+    B, MP = page_table.shape
+    T = tokens.shape[1]
+    max_len = MP * ps
+    start = start.astype(jnp.int32)
+    nreal = nreal.astype(jnp.int32)
+    positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)  # [B, K1]
+    x, _ = bert.embed(params, cfg, tokens, jnp.zeros_like(tokens),
+                      dtype=dtype, deterministic=True,
+                      position_ids=positions)
+    vis = (jnp.arange(max_len, dtype=jnp.int32)[None, None, :]
+           <= positions[:, :, None])                    # [B, K1, max_len]
+    bias = jnp.where(vis, 0.0, -1e9).astype(jnp.float32)[:, None]
+    gidx = _flat_gather_idx(page_table, ps)
+    in_chunk = jnp.arange(T, dtype=jnp.int32)[None, :] < nreal[:, None]
+    lp = jnp.clip(positions // ps, 0, MP - 1)
+    phys = jnp.take_along_axis(page_table, lp, axis=1)   # [B, K1]
+    wflat = jnp.where(in_chunk & (phys < P) & (positions < max_len),
+                      phys * ps + positions % ps, P * ps)
+    pk = pages_k.reshape(L, P * ps, *tail)
+    pv = pages_v.reshape(L, P * ps, *tail)
+
+    def layer(carry, scanned):
+        x = carry
+        if kv_scales is None:
+            lp_, _, pk_l, pv_l = scanned
+        else:
+            lp_, _, pk_l, pv_l, ks_l, vs_l = scanned
+        q, k_new, v_new = _qkv(x, lp_, cfg, dtype)       # [B, K1, N, D]
+        if kv_scales is None:
+            pk_l = pk_l.at[wflat].set(k_new.astype(pk_l.dtype),
+                                      mode="drop")
+            pv_l = pv_l.at[wflat].set(v_new.astype(pv_l.dtype),
+                                      mode="drop")
+            kf = jnp.take(pk_l, gidx, axis=0, mode="fill", fill_value=0)
+            vf = jnp.take(pv_l, gidx, axis=0, mode="fill", fill_value=0)
+        else:
+            pk_l = pk_l.at[wflat].set(quantize_kv(k_new, ks_l),
+                                      mode="drop")
+            pv_l = pv_l.at[wflat].set(quantize_kv(v_new, vs_l),
+                                      mode="drop")
+            kf = dequantize_kv(
+                jnp.take(pk_l, gidx, axis=0, mode="fill", fill_value=0),
+                ks_l, dtype)
+            vf = dequantize_kv(
+                jnp.take(pv_l, gidx, axis=0, mode="fill", fill_value=0),
+                vs_l, dtype)
+        attn = dot_product_attention(q, kf, vf, bias, impl="auto")
+        return _finish_layer(x, lp_, cfg, attn, dtype), (pk_l, pv_l)
+
+    li = jnp.arange(cfg.num_layers)
+    xs = (params["layers"], li, pk, pv)
+    if kv_scales is not None:
+        xs = xs + (kv_scales[0], kv_scales[1])
+    x, (pk, pv) = jax.lax.scan(layer, x, xs, unroll=unroll)
+    logits = lm_logits(params, head, cfg, x, dtype=dtype)   # [B, K1, V]
+    return (logits, pk.reshape(pages_k.shape), pv.reshape(pages_v.shape))
+
+
 # ------------------------------------------------------- infilling scoring
 
 def infill_logits(params: Params, head: Params, cfg: BertConfig,

@@ -78,6 +78,19 @@ hop                   meaning / extra attrs
                       (``decode*`` may be empty: a stream whose first
                       token is EOS or whose budget is 1 completes
                       straight from prefill)
+``draft``             speculative decoding: the cheap drafter proposed
+                      ``k`` tokens for this stream's next positions
+                      through its own paged KV cache (``slot``, ``k``,
+                      ``drafter_model``, ``replica``) — always
+                      immediately followed by its ``verify``
+``verify``            the primary scored all k+1 drafted positions in
+                      ONE prefill-shaped call and accepted the longest
+                      greedy-matching prefix (``slot``, ``k``,
+                      ``matched`` — this round's accepted count,
+                      ``accepted`` — the stream's CUMULATIVE accepted
+                      drafts, monotone non-decreasing by contract,
+                      ``replica``).  A speculated chain is ``admit →
+                      prefill → (decode | draft verify)* → complete``
 ``complete``          logits delivered (terminal; ``replica``; a shadow
                       duplicate's carries ``shadow=True``)
 ``deadline``          expired before execution (terminal)
@@ -190,7 +203,15 @@ def chain_issues(chain: Sequence[Dict]) -> List[str]:
       happy path; a mid-decode replica kill inserts ``requeue`` followed
       by a SECOND ``prefill`` on the survivor (the continuation re-runs
       ``prompt + emitted``), which is legal — what is not legal is
-      decoding from a cache no prefill filled.
+      decoding from a cache no prefill filled;
+    - a SPECULATED chain (``draft``/``verify`` hops) pairs them: every
+      ``verify`` must immediately follow its ``draft`` (a verification
+      with no drafted window scored nothing) and every ``draft`` must be
+      immediately followed by its ``verify`` (a drafted window nobody
+      verified could leak unverified tokens); a ``draft`` needs an
+      earlier ``prefill`` like any decode; and the ``accepted`` attr —
+      the stream's cumulative accepted drafts — must be monotone
+      non-decreasing across its ``verify`` hops.
 
     Deliberately NO timestamp-order check here:
     :func:`hop_chain`/:func:`chains` hand over chains already sorted by
@@ -226,6 +247,31 @@ def chain_issues(chain: Sequence[Dict]) -> List[str]:
             issues.append("'decode' hop with no earlier 'prefill' — the "
                           "stream decoded from a cache slot no prefill "
                           "filled")
+    if "draft" in hops or "verify" in hops:
+        for i, h in enumerate(hops):
+            if h == "verify" and (i == 0 or hops[i - 1] != "draft"):
+                issues.append("'verify' hop not immediately preceded by "
+                              "its 'draft' — a verification with no "
+                              "drafted window")
+                break
+            if h == "draft" and (i + 1 >= len(hops)
+                                 or hops[i + 1] != "verify"):
+                issues.append("'draft' hop not immediately followed by "
+                              "its 'verify' — a drafted window nobody "
+                              "verified")
+                break
+        if "draft" in hops:
+            first_draft = hops.index("draft")
+            if "prefill" not in hops[:first_draft]:
+                issues.append("'draft' hop with no earlier 'prefill' — "
+                              "the drafter proposed from a cache no "
+                              "prefill filled")
+        acc = [a.get("accepted") for a, h in zip(attrs, hops)
+               if h == "verify" and a.get("accepted") is not None]
+        if any(b < a for a, b in zip(acc, acc[1:])):
+            issues.append("'verify' accepted counts not monotone "
+                          "non-decreasing — cumulative acceptance ran "
+                          "backwards")
     terminals = [h for h in hops if h in TERMINAL_HOPS]
     if len(terminals) == 0:
         issues.append("no terminal hop (orphaned request)")
@@ -269,7 +315,9 @@ def validate_chains(records: Sequence[Dict],
     report = {"checked": len(ids), "complete": 0, "incomplete": {},
               "requeued": 0, "repacked": 0, "hedged": 0,
               "shadowed": 0, "degraded": 0, "rolled_back": 0,
-              "streamed": 0, "re_prefilled": 0}
+              "streamed": 0, "re_prefilled": 0,
+              "speculated": 0, "accept_rate": None}
+    drafted = accepted = 0
     for rid in ids:
         chain = by_id.get(rid, [])
         issues = chain_issues(chain)
@@ -296,6 +344,14 @@ def validate_chains(records: Sequence[Dict],
             report["streamed"] += 1
         if prefills > 1:  # a requeued stream re-prefilled on a survivor
             report["re_prefilled"] += 1
+        drafts = [h for h in hops if h.get("hop") == "draft"]
+        if drafts:
+            report["speculated"] += 1
+            drafted += sum(int(h.get("k") or 0) for h in drafts)
+            accepted += sum(int(h.get("matched") or 0) for h in hops
+                            if h.get("hop") == "verify")
+    if drafted:
+        report["accept_rate"] = round(accepted / drafted, 4)
     return report
 
 

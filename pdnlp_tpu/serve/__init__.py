@@ -60,7 +60,8 @@ from pdnlp_tpu.serve.kvpage import (  # noqa: F401
     KVPagesExhausted, PageAllocator, PrefixIndex,
 )
 from pdnlp_tpu.serve.fleet import (  # noqa: F401
-    FleetRouter, ModelSpec, RolloutPlan, ShadowReport, parse_fleet_spec,
+    FleetRouter, ModelSpec, RolloutPlan, ShadowReport, drafter_spec,
+    parse_fleet_spec, parse_speculate_spec,
 )
 from pdnlp_tpu.serve.metrics import (  # noqa: F401
     DecodeMetrics, FleetMetrics, ReplicaMetrics, RouterMetrics,
@@ -100,7 +101,9 @@ __all__ = [
     "ServeController",
     "ServeMetrics",
     "ShadowReport",
+    "drafter_spec",
     "parse_fleet_spec",
+    "parse_speculate_spec",
     "pick_bucket",
     "resolve_serve_pack",
     "score_texts",

@@ -119,6 +119,13 @@ def default_specs() -> Dict[str, KnobSpec]:
         "canary_fraction": KnobSpec("canary_fraction", 0.0, 1.0,
                                     cooldown_s=5.0, hysteresis=0.0,
                                     signal="p99_ms", noise_floor=5.0),
+        # speculative decoding's draft depth: judged against spec_waste
+        # (1 - acceptance, "bad is high" like every revert signal) so a
+        # k the drafter cannot cash auto-reverts; hysteresis 0 because
+        # the law moves in single integer steps
+        "draft_k": KnobSpec("draft_k", 0, 8, cooldown_s=5.0,
+                            hysteresis=0.0, signal="spec_waste",
+                            noise_floor=0.05, integer=True),
     }
 
 
@@ -138,7 +145,19 @@ class _Sense:
         self.util: Optional[float] = kw.get("util")
         self.active: int = kw.get("active", 0)
         self.standby: int = kw.get("standby", 0)
+        #: windowed speculative-decoding acceptance (accepted/drafted
+        #: over this tick's counter delta; None = no drafting happened)
+        self.accept_rate: Optional[float] = kw.get("accept_rate")
         self.knobs: Dict = kw.get("knobs", {})
+
+    @property
+    def spec_waste(self) -> Optional[float]:
+        """Fraction of drafted tokens the verify call threw away —
+        speculation's "bad is high" signal (the ``draft_k`` knob's
+        revert judge)."""
+        if self.accept_rate is None:
+            return None
+        return 1.0 - self.accept_rate
 
     @property
     def slo_pressure(self) -> Optional[float]:
@@ -158,6 +177,7 @@ class _Sense:
     def as_dict(self) -> Dict:
         out = {k: v for k, v in vars(self).items() if k != "knobs"}
         out["slo_pressure"] = self.slo_pressure
+        out["spec_waste"] = self.spec_waste
         return out
 
 
@@ -209,6 +229,9 @@ class ServeController:
                  util_low: float = 0.15,
                  util_high: float = 0.75,
                  util_batch: float = 0.5,
+                 accept_floor: float = 0.35,
+                 accept_high: float = 0.85,
+                 spec_patience: int = 2,
                  scale_patience: int = 3,
                  ewma_alpha: float = 0.4,
                  batch_rows: Optional[int] = None,
@@ -256,6 +279,14 @@ class ServeController:
         #: pool should trade its abundant rows for latency, not the
         #: reverse
         self.util_batch = float(util_batch)
+        #: speculation law bands: below the floor for ``spec_patience``
+        #: consecutive ticks the drafter is wasting its k (halve it /
+        #: switch speculation off); above the high band the drafter is
+        #: cashing almost everything (a deeper k is free upside)
+        self.accept_floor = float(accept_floor)
+        self.accept_high = float(accept_high)
+        self.spec_patience = int(spec_patience)
+        self._spec_low_ticks = 0
         self.scale_patience = int(scale_patience)
         self.ewma_alpha = float(ewma_alpha)
         self.batch_rows = int(batch_rows
@@ -357,12 +388,15 @@ class ServeController:
         now = self.clock()
         r = snap.get("router", {})
         adm = r.get("admission", {})
+        spec = snap.get("speculation") or {}
         counters = {
             "requests": r.get("requests_total", 0),
             "deadline": r.get("deadline_expired_total", 0),
             "shed": adm.get("shed", 0),
             "rejected": adm.get("rejected", 0),
             "backpressure": adm.get("backpressure_waits", 0),
+            "draft_tokens": spec.get("draft_tokens", 0),
+            "accepted_tokens": spec.get("accepted_tokens", 0),
         }
         prev, prev_t = self._prev_counters, self._prev_t
         self._prev_counters, self._prev_t = counters, now
@@ -403,6 +437,12 @@ class ServeController:
             # control_snapshot already carries the knobs on every tick
             knobs=(snap["knobs"] if "knobs" in snap
                    else self.router.knob_values()),
+            # windowed acceptance: this tick's drafted/accepted deltas,
+            # not the lifetime ratio — a drafter that goes cold must show
+            # up within spec_patience ticks, and a cumulative rate
+            # converges far too slowly for that
+            accept_rate=(d["accepted_tokens"] / d["draft_tokens"]
+                         if d["draft_tokens"] > 0 else None),
         )
 
     # --------------------------------------------------------------- decide
@@ -413,6 +453,7 @@ class ServeController:
         self._decide_flush_age(s, cause)
         self._decide_admission(s, cause)
         self._decide_replicas(s, cause)
+        self._decide_speculation(s, cause)
         self._decide_rollout(s, cause)
 
     def _wants(self, knob: str, current, target) -> bool:
@@ -516,6 +557,40 @@ class ServeController:
                 self._actuate("replicas", s.active - 1, cause)
         else:
             self._low_ticks = 0
+
+    def _decide_speculation(self, s: _Sense, cause: Dict) -> None:
+        """The speculation law: the drafter earns its k or loses it.
+
+        Windowed acceptance below ``accept_floor`` for ``spec_patience``
+        consecutive ticks means the cheap model is drafting tokens the
+        primary keeps refusing — every rejected draft is a wasted drafter
+        step AND a wasted verify column, so halve k (switch speculation
+        off entirely when acceptance is catastrophic or k is already at
+        1).  Acceptance above ``accept_high`` means nearly every draft is
+        landing: a deeper k is close-to-free upside, step it up by one.
+        Both moves route through :meth:`_actuate`, so they are clamped to
+        the ``draft_k`` spec, hold-off/cooldown gated, decision-recorded,
+        and auto-revert-eligible on ``spec_waste`` regression.
+        ``accept_rate is None`` (no drafting happened in the window —
+        speculation off or traffic idle) ticks the law to a standstill:
+        re-enable is the revert path's job, not a blind retry."""
+        cur = s.knobs.get("draft_k")
+        if s.accept_rate is None or cur is None or cur <= 0:
+            self._spec_low_ticks = 0
+            return
+        cur = int(cur)
+        if s.accept_rate < self.accept_floor:
+            self._spec_low_ticks += 1
+            if self._spec_low_ticks >= self.spec_patience:
+                self._spec_low_ticks = 0
+                target = 0 if (s.accept_rate < self.accept_floor / 2
+                               or cur <= 1) else cur // 2
+                self._actuate("draft_k", target, cause)
+            return
+        self._spec_low_ticks = 0
+        if s.accept_rate > self.accept_high \
+                and cur < int(self.specs["draft_k"].hi):
+            self._actuate("draft_k", cur + 1, cause)
 
     def _decide_rollout(self, s: _Sense, cause: Dict) -> None:
         """The canary-rollout law: step ``canary_fraction`` up the

@@ -48,8 +48,28 @@ decode batch partially empty (continuous batching).
   emits exactly the tokens the dead replica would have (no duplicates,
   no losses; the chain shows ``requeue`` then a second ``prefill``).
 
-Hop chains (``obs.request``): ``admit → prefill → decode* → complete``,
-with ``decode`` hops carrying ``slot``/``step``/``tokens_out`` so
+- **speculative decoding** (draft-k / verify-1): a paired CHEAP engine
+  (the fleet's ``cheap`` role) drafts k tokens per round with its own
+  paged cache via k fixed-shape decode steps, then the primary scores
+  all k+1 window positions in ONE prefill-shaped ``verify_ids`` call
+  (``models.decoder.paged_verify_step``, compile key ``("verify",
+  slots, k+1)`` — retrace-free by construction).  The longest accepted
+  greedy prefix commits to both caches: the primary's commit IS the
+  verify call's K/V written through the page table (rejected tail
+  positions stay invisible behind the position mask and are overwritten
+  in place next round), the drafter's rejected pages stay under the
+  two-owner draft custody (``kvpage.draft_owner`` + ``transfer``) until
+  a later round commits across them.  Greedy verification makes the
+  emitted sequence IDENTICAL to primary-only decode — every emitted
+  token is a primary argmax — which the bench gates stream-for-stream.
+  A drafter death degrades the pair to primary-only decode (loud,
+  decision-recorded); parity is unaffected because the primary cache
+  already holds every committed token.
+
+Hop chains (``obs.request``): ``admit → prefill → (decode | draft
+verify)* → complete``, with ``decode`` hops carrying
+``slot``/``step``/``tokens_out`` and speculation rounds carrying
+``draft``/``verify`` pairs (``k``/``accepted``/``drafter_model``) so
 ``trace_tpu.py request <id>`` reconstructs a stream's whole life.
 """
 from __future__ import annotations
@@ -66,6 +86,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pdnlp_tpu.models import decoder
+from pdnlp_tpu.obs.decision import mint_decision_id, record_decision
 from pdnlp_tpu.obs.memory import KVBudget
 from pdnlp_tpu.obs.request import mint_request_id, record_hop
 from pdnlp_tpu.serve.batcher import (
@@ -75,7 +96,7 @@ from pdnlp_tpu.serve.batcher import (
 from pdnlp_tpu.serve.engine import InferenceEngine
 from pdnlp_tpu.serve.kvpage import (
     INDEX_OWNER, KVPagesExhausted, PageAllocator, PrefixHit, PrefixIndex,
-    pages_needed,
+    draft_owner, pages_needed,
 )
 from pdnlp_tpu.serve.metrics import DecodeMetrics, ReplicaMetrics
 from pdnlp_tpu.train import checkpoint as ckpt
@@ -578,7 +599,7 @@ class _PageClaim:
     hit; the divergent suffix for a partial one)."""
 
     __slots__ = ("owner", "kind", "tokens", "n_prompt_pages",
-                 "first_token", "suffix", "start")
+                 "first_token", "suffix", "start", "draft_from")
 
     def __init__(self, owner: str, kind: str, tokens: List[int],
                  n_prompt_pages: int, first_token: Optional[int] = None,
@@ -590,6 +611,8 @@ class _PageClaim:
         self.first_token = first_token      # full hits: stored token 0
         self.suffix = suffix or []          # partial hits: the chunk
         self.start = start                  # partial hits: suffix offset
+        self.draft_from = None              # drafter engines: first page
+        #                                     index under draft custody
 
 
 class PagedDecodeEngine(DecodeEngine):
@@ -672,6 +695,13 @@ class PagedDecodeEngine(DecodeEngine):
                 return decoder.paged_chunk_step(
                     params, head, cfg, tokens, pk, pv, table, start,
                     nreal, kv_scales=(ks, vs), dtype=dtype)
+
+            def _pverify_fn(params, head, pk, pv, tokens, table, start,
+                            nreal, ks, vs):
+                metrics_ref.retraces.inc()
+                return decoder.paged_verify_step(
+                    params, head, cfg, tokens, pk, pv, table, start,
+                    nreal, kv_scales=(ks, vs), dtype=dtype)
         else:
             def _pinsert_fn(pk, pv, ks_new, vs_new, flat_pos):
                 metrics_ref.retraces.inc()
@@ -691,6 +721,13 @@ class PagedDecodeEngine(DecodeEngine):
                     params, head, cfg, tokens, pk, pv, table, start,
                     nreal, dtype=dtype)
 
+            def _pverify_fn(params, head, pk, pv, tokens, table, start,
+                            nreal):
+                metrics_ref.retraces.inc()
+                return decoder.paged_verify_step(
+                    params, head, cfg, tokens, pk, pv, table, start,
+                    nreal, dtype=dtype)
+
         def _pcow_fn(pk, pv, src, dst):
             metrics_ref.retraces.inc()
             return decoder.copy_pages(pk, pv, src, dst)
@@ -698,6 +735,7 @@ class PagedDecodeEngine(DecodeEngine):
         self._jit_pinsert = jax.jit(_pinsert_fn, donate_argnums=(0, 1))
         self._jit_pdecode = jax.jit(_pdecode_fn, donate_argnums=(2, 3))
         self._jit_pchunk = jax.jit(_pchunk_fn, donate_argnums=(2, 3))
+        self._jit_pverify = jax.jit(_pverify_fn, donate_argnums=(2, 3))
         self._jit_pcow = jax.jit(_pcow_fn, donate_argnums=(0, 1))
 
     # --------------------------------------------------------- capacity
@@ -882,6 +920,49 @@ class PagedDecodeEngine(DecodeEngine):
         self._slot_state[slot] = None
         self._table[slot, :] = self.n_pages
         self.allocator.release_owner(st.owner)
+        # drafter engines: tentative (uncommitted) pages live under the
+        # draft owner — release them too or a drained audit reports the
+        # "#draft" alias as a leak
+        self.allocator.release_owner(draft_owner(st.owner))
+
+    # ---------------------------------------------- draft page custody
+    # Two-owner custody for speculative decoding (DRAFTER-side engine):
+    # pages wholly beyond the committed cache length hold only tentative
+    # drafted K/V, so they belong to ``draft_owner(rid)`` — the ledger
+    # then names exactly which pages a rejection would strand, and
+    # ``transfer`` (a leaklint-recognised releaser) moves each page to
+    # the stream owner the moment a verify round commits across it.
+    def split_draft_custody(self, slot: int, committed_len: int) -> None:
+        """Move the reservation's pages wholly beyond ``committed_len``
+        positions to the slot's draft owner (post-attach, pre-draft)."""
+        st = self._slot_state[slot] if 0 <= slot < self.slots else None
+        if st is None:
+            return
+        n_commit = pages_needed(committed_len, self.page_sz)
+        pages = [int(p) for p in self._table[slot] if p < self.n_pages]
+        tail = pages[n_commit:]
+        if tail:
+            self.allocator.transfer(tail, st.owner,
+                                    draft_owner(st.owner))
+        st.draft_from = n_commit
+
+    def commit_draft(self, slot: int, committed_len: int) -> None:
+        """A verify round accepted tokens through ``committed_len``
+        positions: transfer every boundary-crossed page back to the
+        stream owner.  Rejected pages simply stay under draft custody —
+        the next round overwrites them in place."""
+        st = self._slot_state[slot] if 0 <= slot < self.slots else None
+        if st is None or st.draft_from is None:
+            return
+        n_commit = pages_needed(committed_len, self.page_sz)
+        if n_commit <= st.draft_from:
+            return
+        pages = [int(p) for p in self._table[slot] if p < self.n_pages]
+        crossed = pages[st.draft_from:n_commit]
+        if crossed:
+            self.allocator.transfer(crossed, draft_owner(st.owner),
+                                    st.owner)
+        st.draft_from = n_commit
 
     def register_slot(self, slot: int, first_token: int) -> None:
         if not self.prefix_share:
@@ -1068,6 +1149,61 @@ class PagedDecodeEngine(DecodeEngine):
             out = np.asarray(jax.device_get(logits))
         return out
 
+    def verify_ids(self, window: np.ndarray, pos: np.ndarray,
+                   nreal: np.ndarray, live: int,
+                   request_ids=None) -> np.ndarray:
+        """Speculative verify-1: score a fixed ``[slots, k+1]`` token
+        window (pending token + k drafts per live row) in ONE
+        prefill-shaped call against the paged cache
+        (``models.decoder.paged_verify_step``).  Returns ``[slots, k+1,
+        vocab]`` fp32 logits — the greedy target at every window offset.
+        The call IS the primary-side commit: accepted positions' K/V is
+        already written through the table when it returns, and rejected
+        tail writes are invisible behind the position mask (overwritten
+        in place next round).  Compile key ``("verify", slots, k+1)`` —
+        one program per k, retrace-free once warmed
+        (:meth:`warmup_verify`); rows with ``nreal == 0`` are dead and
+        write nothing (sentinel table rows)."""
+        self._flush_cow()
+        k1 = int(window.shape[1])
+        key = ("verify", int(self.slots), k1)
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+            span_name = "verify"
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+            span_name = "compile"
+        tok = np.asarray(window, np.int32).reshape(self.slots, k1)
+        start = np.asarray(pos, np.int32)
+        nr = np.asarray(nreal, np.int32)
+        with self.tracer.span(span_name, rows=int(self.slots),
+                              seq=k1, live=int(live), verify=True,
+                              paged=True,
+                              pages_live=self.allocator.used_pages,
+                              dtype=self.dtype_label,
+                              kv=("int8" if self.kv_int8
+                                  else np.dtype(self.kv_dtype).name),
+                              **self._telemetry_attrs(request_ids),
+                              **self.span_attrs):
+            logits, self._cache_k, self._cache_v = self._jit_pverify(
+                self.params, self.head, self._cache_k, self._cache_v,
+                tok, jnp.asarray(self._table), start, nr,
+                *self._scale_args())
+            out = np.asarray(jax.device_get(logits))
+        return out
+
+    def warmup_verify(self, k1: int) -> None:
+        """Pre-trace the ``("verify", slots, k1)`` program (all-dead
+        window: sentinel tables, zero ``nreal`` — no live page is
+        touched).  The speculating batcher warms its configured
+        ``draft_k + 1``; adapting k at runtime compiles the new width
+        exactly once."""
+        window = np.zeros((self.slots, int(k1)), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        nreal = np.zeros((self.slots,), np.int32)
+        self.verify_ids(window, pos, nreal, live=0)
+
     def warmup_decode(self) -> None:
         """Pre-trace every reachable paged shape: per-bucket prefill +
         paged insert, per-bucket suffix chunk, the ONE decode step, the
@@ -1110,7 +1246,8 @@ class DecodeStream:
 
     __slots__ = ("rid", "prompt_ids", "max_new_tokens", "deadline",
                  "submitted", "born", "first_token_at", "last_token_at",
-                 "emitted", "replica", "slot", "_q", "_event", "_error")
+                 "emitted", "replica", "slot", "spec_accepted",
+                 "_q", "_event", "_error")
 
     def __init__(self, prompt_ids: List[int], max_new_tokens: int,
                  deadline: Optional[float] = None):
@@ -1125,6 +1262,7 @@ class DecodeStream:
         self.emitted: List[int] = []
         self.replica: Optional[int] = None
         self.slot: Optional[int] = None
+        self.spec_accepted = 0  # cumulative accepted drafts (monotone)
         self._q: "queue.Queue" = queue.Queue()
         self._event = threading.Event()
         self._error: Optional[BaseException] = None
@@ -1195,15 +1333,59 @@ class DecodeBatcher:
     :class:`DecodeRouter`; a worker that loses its engine hands over its
     live + waiting streams instead of failing them."""
 
+    #: declared safe range for the ``draft_k`` knob (the controller
+    #: clamps inside it; ``0`` = speculation off)
+    DRAFT_K_MAX = 8
+
     def __init__(self, engine: DecodeEngine, *, max_waiting: int = 256,
                  default_max_new: Optional[int] = None, replica: int = 0,
                  on_death: Optional[Callable] = None,
                  rmetrics: Optional[ReplicaMetrics] = None,
-                 dmetrics: Optional[DecodeMetrics] = None):
+                 dmetrics: Optional[DecodeMetrics] = None,
+                 drafter: Optional[DecodeEngine] = None,
+                 draft_k: int = 4):
         self.engine = engine
         self.tracer = engine.tracer
         self.replica = int(replica)
         engine.span_attrs.setdefault("replica", self.replica)
+        # --- speculative decoding: a paired cheap drafter engine ---
+        self.drafter: Optional[DecodeEngine] = None
+        self.drafter_model = ""
+        self.draft_k = max(0, min(int(draft_k), self.DRAFT_K_MAX))
+        self._drafter_poison: Optional[BaseException] = None
+        self._spec_rounds = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        if drafter is not None:
+            if not (engine.paged and drafter.paged):
+                raise ValueError(
+                    "speculative decoding needs PAGED engines on both "
+                    "sides (--kv_layout paged): the verify commit and "
+                    "the draft-page custody both write through page "
+                    "tables")
+            if (drafter.slots != engine.slots
+                    or drafter.max_len != engine.max_len):
+                raise ValueError(
+                    f"drafter geometry (slots={drafter.slots}, "
+                    f"max_len={drafter.max_len}) must match the "
+                    f"primary (slots={engine.slots}, "
+                    f"max_len={engine.max_len}) — the pair shares slot "
+                    "indices and write positions")
+            if drafter.prefix_share:
+                raise ValueError(
+                    "drafter engine must run prefix_share=False: its "
+                    "cold prefill rewrites each stream's pages in "
+                    "place, which would corrupt shared prefix pages")
+            if drafter.tokenizer.vocab_size != engine.tokenizer.vocab_size:
+                raise ValueError(
+                    "drafter and primary must share one tokenizer: "
+                    "drafted token ids are verified (and committed) "
+                    "against the primary's vocab")
+            drafter.span_attrs.setdefault("replica", self.replica)
+            drafter.span_attrs.setdefault("role", "drafter")
+            self.drafter = drafter
+            self.drafter_model = str(getattr(drafter.args, "model",
+                                             "drafter"))
         self.max_waiting = int(max_waiting)
         self.default_max_new = int(
             default_max_new
@@ -1258,6 +1440,8 @@ class DecodeBatcher:
             self._free = deque(range(self.engine.slots))
         for i in still_live:
             self.engine.detach_slot(i)  # pages back; leak_check clean
+            if self.drafter is not None:
+                self.drafter.detach_slot(i)
         for s in leftovers:
             if s._finish(RuntimeError("decode batcher stopped")):
                 record_hop(self.tracer, s.rid, "failed",
@@ -1354,6 +1538,11 @@ class DecodeBatcher:
         try:
             while True:
                 claims: List[tuple] = []
+                # the drafter is WORKER-CONFINED, not lock-guarded: the
+                # ctor pairs it before start() and only _degrade_drafter
+                # (this thread) ever clears it — a local read outside
+                # the lock keeps it out of the lock's footprint
+                dr = self.drafter
                 with self._lock:
                     if self._poison is not None:
                         raise self._poison
@@ -1378,6 +1567,26 @@ class DecodeBatcher:
                             self._free.appendleft(slot)
                             self._waiting.appendleft(stream)
                             break
+                        if dr is not None:
+                            try:
+                                dr.attach_stream(slot, stream)
+                            except KVPagesExhausted:
+                                # the PAIR seats together or not at all:
+                                # hand the primary reservation back and
+                                # wait for live streams to drain (same
+                                # no-deadlock floor argument as above,
+                                # on the drafter's pool)
+                                self.engine.detach_slot(slot)
+                                self._free.appendleft(slot)
+                                self._waiting.appendleft(stream)
+                                break
+                            except BaseException as e:  # noqa: BLE001
+                                # drafter-side failure must not strand
+                                # the stream: poison the drafter (the
+                                # next speculate step degrades loudly
+                                # to primary-only) and seat the stream
+                                # without a draft cache
+                                self._drafter_poison = e
                         freed = self._freed_at.pop(slot, None)
                         if freed is not None:
                             self.rmetrics.slot_reuse_ms.observe(
@@ -1404,7 +1613,10 @@ class DecodeBatcher:
                     # read it bare (threadlint T1)
                     any_live = self._live_count() > 0
                 if any_live:
-                    self._decode_step()
+                    if self.drafter is not None and self.draft_k > 0:
+                        self._speculate_step()
+                    else:
+                        self._decode_step()
                 with self._lock:
                     self._wake.notify_all()
         except BaseException as e:  # noqa: BLE001 — a dead engine must
@@ -1439,6 +1651,29 @@ class DecodeBatcher:
         hits too, with ``prefix_hit``/``cached_tokens`` telling the
         story."""
         rows = self.engine.prefill_rows
+        if self.drafter is not None:
+            # the drafter's cache needs the SAME prompt K/V before it
+            # can draft: always the cold path (prefix_share is off on
+            # drafter engines), chunked to the drafter's fixed rows,
+            # then the reservation's uncommitted tail moves to the
+            # draft owner.  Any failure here degrades the pair to
+            # primary-only decode — the primary prefill below still
+            # seats every stream.
+            try:
+                if self._drafter_poison is not None:
+                    raise self._drafter_poison
+                rows_d = self.drafter.prefill_rows
+                for i in range(0, len(claims), rows_d):
+                    ch = claims[i:i + rows_d]
+                    self.drafter.prefill_ids(
+                        [s.prompt_ids + s.emitted for _, s, _ in ch],
+                        [slot for slot, _, _ in ch],
+                        request_ids=[s.rid for _, s, _ in ch])
+                    for slot, s, _ in ch:
+                        self.drafter.split_draft_custody(
+                            slot, len(s.prompt_ids) + len(s.emitted))
+            except BaseException as e:  # noqa: BLE001
+                self._degrade_drafter(e)
         full = [c for c in claims
                 if c[2] is not None and c[2].kind == "full"]
         part = [c for c in claims
@@ -1528,6 +1763,8 @@ class DecodeBatcher:
             # prefix pages stay live under the index / other streams);
             # worker-only, so after the lock is fine
             self.engine.detach_slot(slot)
+            if self.drafter is not None:
+                self.drafter.detach_slot(slot)  # draft custody included
             if stream._finish():
                 record_hop(self.tracer, stream.rid, "complete",
                            replica=self.replica, slot=slot,
@@ -1565,6 +1802,189 @@ class DecodeBatcher:
                        replica=self.replica)
             self._advance(i, sl.stream, tok, pos=sl.pos + 1)
         self._update_kv_gauge()
+
+    # ------------------------------------------------------- speculation
+    def _speculate_step(self) -> None:
+        """One draft-k / verify-1 round over the slot block.
+
+        The drafter runs k FIXED-shape decode steps against its own
+        paged cache (feeding each argmax back in — the classic decode
+        loop, just on the cheap model), then the primary scores the
+        whole ``[slots, k+1]`` window ``[pending, draft_1..draft_k]`` in
+        ONE :meth:`PagedDecodeEngine.verify_ids` call.  Row ``i``'s
+        greedy targets ``t_0..t_k`` satisfy: ``t_j`` is the primary's
+        next token after window position ``j``.  The longest prefix with
+        ``draft_j == t_{j-1}`` (length ``a``) is accepted, and the round
+        emits ``t_0..t_a`` — a+1 tokens, every one a PRIMARY argmax, so
+        the emitted sequence is identical to primary-only greedy decode
+        whatever the drafter says (worst case a=0 still emits ``t_0``,
+        the plain decode step's token).  The verify call already wrote
+        the accepted positions' K/V (primary commit); the drafter's
+        boundary-crossed pages transfer to the stream owner
+        (:meth:`PagedDecodeEngine.commit_draft`) and its rejected tail
+        is overwritten in place next round.  A drafter failure anywhere
+        degrades to :meth:`_decode_step` — loudly, decision-recorded —
+        and the round re-runs primary-only."""
+        k = self.draft_k
+        eng, dr = self.engine, self.drafter
+        tokens = np.zeros((eng.slots,), np.int32)
+        pos = np.zeros((eng.slots,), np.int32)
+        with self._lock:
+            live = [(i, sl) for i, sl in enumerate(self._slots)
+                    if sl is not None]
+            for i, sl in live:
+                tokens[i] = sl.next_token
+                pos[i] = sl.pos
+        if not live:
+            return
+        rids = [sl.stream.rid for _, sl in live]
+        window = np.zeros((eng.slots, k + 1), np.int32)
+        window[:, 0] = tokens
+        try:
+            if self._drafter_poison is not None:
+                raise self._drafter_poison
+            cur = tokens.copy()
+            for j in range(k):
+                dlogits = dr.decode_batch(cur, pos + j, live=len(live),
+                                          request_ids=rids)
+                cur = np.argmax(dlogits, axis=-1).astype(np.int32)
+                window[:, j + 1] = cur
+        except BaseException as e:  # noqa: BLE001 — drafter death must
+            self._degrade_drafter(e)  # never take the primary with it
+            self._decode_step()
+            return
+        self.metrics.draft_tokens_total.inc(k * len(live))
+        self.metrics.spec_rounds_total.inc()
+        self._spec_rounds += 1
+        nreal = np.zeros((eng.slots,), np.int32)
+        for i, _ in live:
+            nreal[i] = k + 1
+        vlogits = eng.verify_ids(window, pos, nreal, live=len(live),
+                                 request_ids=rids)
+        self.metrics.verify_calls_total.inc()
+        self.metrics.decode_steps_total.inc()
+        targets = np.argmax(vlogits, axis=-1)        # [slots, k+1]
+        self.rmetrics.slot_occupancy.observe(
+            len(live) / float(eng.slots))
+        self.rmetrics.batches_total.inc()
+        for i, sl in live:
+            a = 0
+            while a < k and window[i, a + 1] == targets[i, a]:
+                a += 1
+            stream = sl.stream
+            stream.spec_accepted += a
+            self._spec_drafted += k
+            self._spec_accepted += a
+            self.metrics.accepted_tokens_total.inc(a)
+            # hops BEFORE advancing, so a completing stream's terminal
+            # stays last; accepted is CUMULATIVE per stream (the chain
+            # rule pins it monotone)
+            record_hop(self.tracer, stream.rid, "draft", slot=i, k=k,
+                       drafter_model=self.drafter_model,
+                       replica=self.replica)
+            record_hop(self.tracer, stream.rid, "verify", slot=i, k=k,
+                       matched=a, accepted=stream.spec_accepted,
+                       replica=self.replica)
+            base = sl.pos
+            for m in range(a + 1):
+                self._advance(i, stream, int(targets[i, m]),
+                              pos=base + m + 1)
+                with self._lock:
+                    freed = self._slots[i] is None
+                if freed:
+                    break
+            else:
+                # stream survived the round: its committed cache length
+                # is the new pending write position — move any
+                # boundary-crossed draft pages to the stream owner
+                dr.commit_draft(i, base + a + 1)
+        if self._spec_drafted:
+            self.metrics.accept_rate.set(
+                self._spec_accepted / float(self._spec_drafted))
+        self._update_kv_gauge()
+
+    def _degrade_drafter(self, error: BaseException) -> None:
+        """Drafter death mid-storm: degrade the pair to primary-only
+        decode — LOUD, decision-recorded, streams keep flowing.  Parity
+        is unaffected: the primary cache holds every committed token, so
+        plain decode continues the exact greedy sequence.  Worker-only
+        (like every engine call); must NOT be called with ``_lock``
+        held."""
+        dr, k_old = self.drafter, self.draft_k
+        if dr is None:
+            return
+        self.drafter = None
+        self._drafter_poison = None
+        print(f"[serve.decode] replica {self.replica}: drafter "
+              f"{self.drafter_model!r} died "
+              f"({type(error).__name__}: {error}) — degrading to "
+              "primary-only decode", file=sys.stderr)
+        self.metrics.drafter_deaths_total.inc()
+        did = mint_decision_id()
+        record_decision(self.tracer, did, "action", knob="draft_k",
+                        old=k_old, new=0, forced=True,
+                        replica=self.replica,
+                        cause={"kind": "drafter_death",
+                               "error": type(error).__name__,
+                               "drafter_model": self.drafter_model})
+        record_decision(self.tracer, did, "outcome", knob="draft_k",
+                        result="degraded", kept=True,
+                        replica=self.replica)
+        with self._lock:
+            live = [i for i, sl in enumerate(self._slots)
+                    if sl is not None]
+        for i in live:
+            try:
+                dr.detach_slot(i)  # draft custody released with it
+            except BaseException:  # noqa: BLE001 — best-effort: the
+                pass               # engine may be the thing that died
+
+    def kill_drafter(self, error: Optional[BaseException] = None) -> None:
+        """Chaos hook (tests / ``bench.py --decode``): the next
+        speculation round sees the drafter raise — exactly the path a
+        real drafter engine failure takes."""
+        self._drafter_poison = error or RuntimeError(
+            "injected drafter kill")
+
+    def set_draft_k(self, k: int) -> int:
+        """Actuate the ``draft_k`` knob (controller/router door): clamp
+        into the declared safe range and apply before the next round.
+        ``0`` pauses speculation (plain decode steps; the drafter cache
+        goes stale, so acceptance restarts low if re-enabled — the
+        controller's revert law owns that call).  A new k's verify
+        width compiles exactly once."""
+        k = max(0, min(int(k), self.DRAFT_K_MAX))
+        with self._lock:
+            self.draft_k = k
+        return k
+
+    def spec_snapshot(self) -> Dict:
+        """Speculation accounting for ``control_snapshot``/``healthz``:
+        configured k, live acceptance, and the per-model split the
+        exporter renders with ``{model=...}`` labels."""
+        drafted, accepted = self._spec_drafted, self._spec_accepted
+        rate = accepted / float(drafted) if drafted else 0.0
+        out = {
+            "enabled": int(self.drafter is not None),
+            "draft_k": int(self.draft_k),
+            "draft_tokens": int(drafted),
+            "accepted_tokens": int(accepted),
+            "accept_rate": rate,
+            "rounds": int(self._spec_rounds),
+        }
+        if self.drafter_model:
+            primary = str(getattr(self.engine.args, "model", "primary"))
+            # a same-architecture drafter (distilled checkpoint) shares
+            # the primary's model name — suffix its label so the two
+            # Prometheus series never collapse into one
+            dm = self.drafter_model if self.drafter_model != primary \
+                else self.drafter_model + "-draft"
+            out["by_model"] = {
+                dm: {"draft_tokens": int(drafted), "role": "drafter"},
+                primary: {"accepted_tokens": int(accepted),
+                          "accept_rate": rate},
+            }
+        return out
 
     def _update_kv_gauge(self) -> None:
         with self._lock:
@@ -1607,14 +2027,28 @@ class DecodeBatcher:
     # ------------------------------------------------------------ surface
     def warmup(self) -> None:
         self.engine.warmup_decode()
+        if self.drafter is not None:
+            # drafter decode + the primary's verify width: after this,
+            # a full speculation round compiles nothing
+            self.drafter.warmup_decode()
+            self.engine.warmup_verify(self.draft_k + 1)
 
     def snapshot(self) -> Dict:
-        return {
+        out = {
             "decode": self.metrics.snapshot(),
             "replica": self.rmetrics.snapshot(),
             "kv": self.engine.kv_snapshot(),
             "engine": self.engine.metrics.snapshot(),
         }
+        if self.drafter is not None or self._spec_rounds:
+            out["speculation"] = self.spec_snapshot()
+            if self.drafter is not None:
+                out["drafter"] = {
+                    "model": self.drafter_model,
+                    "kv": self.drafter.kv_snapshot(),
+                    "engine": self.drafter.metrics.snapshot(),
+                }
+        return out
 
 
 class DecodeRouter:
@@ -1630,13 +2064,19 @@ class DecodeRouter:
 
     def __init__(self, engines: Sequence[DecodeEngine], *,
                  max_waiting: int = 256,
-                 default_max_new: Optional[int] = None):
+                 default_max_new: Optional[int] = None,
+                 drafters: Optional[Sequence[DecodeEngine]] = None,
+                 draft_k: int = 4):
         assert engines
         self.tracer = engines[0].tracer
+        drafters = list(drafters or [])
         self.batchers = [
             DecodeBatcher(e, max_waiting=max_waiting,
                           default_max_new=default_max_new, replica=i,
-                          on_death=self._on_death)
+                          on_death=self._on_death,
+                          drafter=(drafters[i] if i < len(drafters)
+                                   else None),
+                          draft_k=draft_k)
             for i, e in enumerate(engines)]
 
     def start(self) -> "DecodeRouter":
@@ -1675,6 +2115,51 @@ class DecodeRouter:
     def kill(self, replica: int,
              error: Optional[BaseException] = None) -> None:
         self.batchers[replica].kill(error)
+
+    def kill_drafter(self, replica: int,
+                     error: Optional[BaseException] = None) -> None:
+        """Chaos hook: kill replica's DRAFTER only — the pair must
+        degrade to primary-only decode, not stall."""
+        self.batchers[replica].kill_drafter(error)
+
+    # ------------------------------------------------- controller surface
+    def knob_values(self) -> Dict:
+        """The tuning surface the :class:`ServeController` senses (its
+        ``router.knob_values()`` quack): ``draft_k`` is the one decode
+        knob so far — present only when some pair actually speculates,
+        so the controller's speculation law stays dormant on plain
+        pools."""
+        ks = [b.draft_k for b in self.batchers if b.drafter is not None]
+        return {"draft_k": int(ks[0])} if ks else {}
+
+    def apply_knob(self, knob: str, value) -> None:
+        """Controller actuation door (``ServeController._actuate`` ->
+        ``_apply``): fan the knob to every speculating pair."""
+        if knob != "draft_k":
+            raise ValueError(f"unknown decode knob {knob!r}")
+        for b in self.batchers:
+            if b.drafter is not None or b.draft_k != int(value):
+                b.set_draft_k(int(value))
+
+    def health_summary(self) -> Dict:
+        """Compact ``/healthz`` block (exporter ``health_sources``):
+        liveness + the speculation story at a glance."""
+        spec = [b for b in self.batchers
+                if b.drafter is not None or b._spec_rounds]
+        drafted = sum(b._spec_drafted for b in spec)
+        accepted = sum(b._spec_accepted for b in spec)
+        return {
+            "alive": len(self.alive()),
+            "replicas": len(self.batchers),
+            "speculating": sum(1 for b in self.batchers
+                               if b.drafter is not None),
+            "draft_k": self.knob_values().get("draft_k", 0),
+            "accept_rate": (accepted / float(drafted) if drafted
+                            else 0.0),
+            "drafter_deaths": sum(
+                int(b.metrics.drafter_deaths_total.value)
+                for b in self.batchers),
+        }
 
     def _on_death(self, replica: int, orphans: List[DecodeStream],
                   error: BaseException) -> None:
@@ -1721,11 +2206,30 @@ class DecodeRouter:
                "cow_copies": 0, "evictions": 0, "alloc_failures": 0,
                "hits_full": 0, "hits_partial": 0, "misses": 0,
                "index_entries": 0}
+        spec_agg = {"enabled": 0, "draft_tokens": 0,
+                    "accepted_tokens": 0, "rounds": 0,
+                    "drafter_deaths": 0}
+        spec_models: Dict[str, Dict] = {}
         for b in self.batchers:
             kv = b.engine.kv_snapshot()
             rep: Dict = {"alive": int(not b.dead), "load": b.load,
                          "peak_live_streams": b._peak_live,
                          "layout": kv.get("layout", "slots")}
+            if b.drafter is not None or b._spec_rounds:
+                sp = b.spec_snapshot()
+                rep["speculation"] = sp
+                spec_agg["enabled"] += sp["enabled"]
+                spec_agg["draft_tokens"] += sp["draft_tokens"]
+                spec_agg["accepted_tokens"] += sp["accepted_tokens"]
+                spec_agg["rounds"] += sp["rounds"]
+                for m, leaf in (sp.get("by_model") or {}).items():
+                    dst = spec_models.setdefault(m, {})
+                    for lk, lv in leaf.items():
+                        if isinstance(lv, (int, float)) \
+                                and not isinstance(lv, bool):
+                            dst[lk] = dst.get(lk, 0) + lv
+            spec_agg["drafter_deaths"] += int(
+                b.metrics.drafter_deaths_total.value)
             pages = kv.get("pages")
             prefix = kv.get("prefix")
             if pages:
@@ -1749,5 +2253,12 @@ class DecodeRouter:
             if looked else 0.0)
         agg["page_occupancy"] = (agg["pages_live"] / agg["pages_total"]
                                  if agg["pages_total"] else 0.0)
+        spec_agg["accept_rate"] = (
+            spec_agg["accepted_tokens"] / float(spec_agg["draft_tokens"])
+            if spec_agg["draft_tokens"] else 0.0)
+        if spec_models:
+            spec_agg["by_model"] = spec_models
         return {"alive": len(self.alive()), "pages": agg,
+                "knobs": self.knob_values(),
+                "speculation": spec_agg,
                 "replicas": reps}

@@ -149,6 +149,47 @@ def parse_fleet_spec(spec: str) -> List[ModelSpec]:
     return specs
 
 
+def parse_speculate_spec(spec: str) -> ModelSpec:
+    """``--speculate`` string -> the drafter's :class:`ModelSpec`.
+
+    Accepts a bare checkpoint path (a distilled same-architecture
+    checkpoint: the model id defaults to ``draft`` and the pool builder
+    keeps the primary's architecture) or a fleet-style entry
+    ``model_id=checkpoint[:dtype]`` whose model id names the drafter's
+    ARCHITECTURE (a :mod:`pdnlp_tpu.models.config` registry key, e.g.
+    ``bert-tiny``).  The returned spec
+    is pinned to role ``cheap`` — the fleet role whose job description
+    (int8/distilled, fast, vocabulary-compatible with the primary) is
+    exactly what a draft model needs — with 1 replica: a drafter rides
+    its primary engine's replica, it is never a pool of its own."""
+    entry = spec.strip()
+    if not entry:
+        raise ValueError("empty --speculate spec")
+    if "=" not in entry:
+        return ModelSpec("draft", entry, role="cheap")
+    model_id, rest = entry.split("=", 1)
+    parts = rest.split(":")
+    if len(parts) > 2:
+        raise ValueError(f"speculate spec {entry!r}: expected "
+                         "model_id=checkpoint[:dtype]")
+    dtype = parts[1] if len(parts) > 1 and parts[1] else "auto"
+    return ModelSpec(model_id.strip(), parts[0] or None, dtype=dtype,
+                     role="cheap")
+
+
+def drafter_spec(specs: Sequence[ModelSpec]) -> Optional[ModelSpec]:
+    """The fleet's speculative-decoding drafter: its ``cheap`` entry.
+
+    The same distilled/int8 variant that absorbs classification overload
+    through the degrade band becomes the draft model in generative
+    serving (draft-k / verify-1 — :mod:`pdnlp_tpu.serve.decode`); one
+    spec, two jobs.  ``None`` when the fleet declares no cheap model."""
+    for s in specs:
+        if s.role == "cheap":
+            return s
+    return None
+
+
 class ShadowReport:
     """Accumulated shadow-pair evidence: per-request argmax parity and
     latency deltas between the primary's answer and the candidate's.

@@ -55,6 +55,22 @@ from pdnlp_tpu.obs.memory import KVBudgetExceeded
 #: owner key for references the prefix index itself holds
 INDEX_OWNER = "__prefix_index__"
 
+#: suffix marking a stream's DRAFT-side page references (speculative
+#: decoding).  Two-owner custody: on the drafter engine, pages wholly
+#: beyond the committed length are held by ``draft_owner(owner)`` while
+#: the drafter writes tentative K/V into them; each verify round
+#: ``transfer``\ s boundary-crossed pages back to the stream owner
+#: (commit), and a rejection simply leaves them under the draft owner to
+#: be overwritten in place next round.  ``detach`` releases both owners,
+#: so drained-allocator audits (and leaklint L1, which recognises
+#: ``transfer`` as a releaser) stay clean.
+DRAFT_SUFFIX = "#draft"
+
+
+def draft_owner(owner: str) -> str:
+    """Owner key for a stream's draft-side (uncommitted) page refs."""
+    return owner + DRAFT_SUFFIX
+
 
 class KVPagesExhausted(KVBudgetExceeded):
     """A page allocation could not be satisfied even after index

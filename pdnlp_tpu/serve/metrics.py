@@ -181,6 +181,21 @@ class DecodeMetrics:
       ``kv_snapshot()``/``control_snapshot()``);
     - ``peak_live_streams`` — high-water concurrent live streams (the
       admitted-concurrency headline the paged-vs-slot bench gates).
+
+    Speculative decoding (draft-k / verify-1) adds its acceptance
+    accounting — the live signal the controller's ``draft_k`` law and
+    the bench's speedup gate both read:
+
+    - ``draft_tokens_total`` / ``accepted_tokens_total`` — tokens the
+      cheap drafter proposed / tokens the primary's verify call kept
+      (their ratio is the acceptance rate; every ACCEPTED token skipped
+      one full primary decode step);
+    - ``verify_calls_total`` / ``spec_rounds_total`` — primary verify
+      dispatches and completed draft→verify rounds;
+    - ``accept_rate`` — live cumulative acceptance gauge (per-stream
+      counts ride the ``verify`` hops);
+    - ``drafter_deaths_total`` — drafter engines lost mid-storm (each
+      one degraded its pair to primary-only decode, decision-recorded).
     """
 
     def __init__(self) -> None:
@@ -191,9 +206,15 @@ class DecodeMetrics:
         self.prefill_tokens_total = Counter()
         self.decode_steps_total = Counter()
         self.tokens_out_total = Counter()
+        self.draft_tokens_total = Counter()
+        self.accepted_tokens_total = Counter()
+        self.verify_calls_total = Counter()
+        self.spec_rounds_total = Counter()
+        self.drafter_deaths_total = Counter()
         self.ttft_ms = Histogram()
         self.intertoken_ms = Histogram()
         self.waiting = Gauge()
+        self.accept_rate = Gauge()
         self.kv_bytes_live = Gauge()
         self.kv_slots_live = Gauge()
         self.kv_pages_live = Gauge()
@@ -209,6 +230,12 @@ class DecodeMetrics:
             "prefill_tokens_total": self.prefill_tokens_total.value,
             "decode_steps_total": self.decode_steps_total.value,
             "tokens_out_total": self.tokens_out_total.value,
+            "draft_tokens_total": self.draft_tokens_total.value,
+            "accepted_tokens_total": self.accepted_tokens_total.value,
+            "verify_calls_total": self.verify_calls_total.value,
+            "spec_rounds_total": self.spec_rounds_total.value,
+            "drafter_deaths_total": self.drafter_deaths_total.value,
+            "accept_rate": self.accept_rate.value,
             "ttft_ms": self.ttft_ms.snapshot(),
             "intertoken_ms": self.intertoken_ms.snapshot(),
             "waiting": self.waiting.value,

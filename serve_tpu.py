@@ -86,13 +86,24 @@ never an OOM); ``--replicas N`` decodes behind a
 re-prefills orphan streams on survivors with no duplicated or lost
 tokens.  ``--max_new_tokens`` bounds each stream's generation.
 
+``--speculate id=ckpt[:dtype]`` (or a bare checkpoint path) adds
+**speculative decoding** to ``--decode``: a cheap drafter engine rides
+each primary replica, drafts ``--draft_k`` tokens per round through its
+own paged KV cache, and the primary verifies all k+1 positions in ONE
+prefill-shaped call — greedy verification makes the output BITWISE
+identical to primary-only decode, only faster.  The live acceptance rate
+rides ``/metrics`` (per-model labels), ``/healthz`` and the snapshot;
+with ``--controller on`` the speculation law adapts ``draft_k`` to it
+(and switches a wasteful drafter off) through the decision-recorded
+actuation path.
+
 Serve-local flags (not ``Args`` fields): ``--checkpoint`` (default: newest
 under ``--output_dir``), ``--buckets 32,64,128``, ``--max_batch_size``,
 ``--max_wait_ms``, ``--max_queue``, ``--deadline_ms``, ``--replicas``,
 ``--hedge_ms``, ``--replica_stall_s``, ``--serve_pack``, ``--controller``,
 ``--min_replicas``, ``--fleet``, ``--shadow_fraction``,
 ``--canary_fraction``, ``--degrade_at``, ``--rollout``, ``--decode``,
-``--input``,
+``--speculate``, ``--draft_k``, ``--input``,
 ``--output``, ``--metrics_path``, ``--no_mesh``.  Everything else (model, dtype, vocab, output_dir, ...) is
 the standard ``Args`` CLI (the decode knobs — ``--decode_slots``,
 ``--decode_max_len``, ``--max_new_tokens``, ``--kv_dtype``,
@@ -286,7 +297,8 @@ def build_fleet(args: Args, specs, *, use_mesh: bool = True,
 def build_decode_pool(args: Args, replicas: int, *,
                       checkpoint: Optional[str] = None,
                       use_mesh: bool = True, buckets=DEFAULT_BUCKETS,
-                      max_waiting: int = 256):
+                      max_waiting: int = 256,
+                      speculate: Optional[str] = None, draft_k: int = 4):
     """Generative serving pool: ``replicas`` :class:`DecodeEngine`\\ s —
     device-group meshes when the host has them, plain jit otherwise —
     behind a :class:`DecodeRouter` (1 replica included: the router is the
@@ -294,7 +306,16 @@ def build_decode_pool(args: Args, replicas: int, *,
     (the default) gives each engine a refcounted page pool with
     cross-request prefix sharing; ``--kv_layout slots`` keeps the classic
     preallocated slot cache (``--decode_slots`` × ``--decode_max_len``
-    positions, ``--kv_dtype`` precision, gated by ``--kv_hbm_mb``)."""
+    positions, ``--kv_dtype`` precision, gated by ``--kv_hbm_mb``).
+
+    ``speculate`` (``--speculate id=ckpt[:dtype]`` or a bare checkpoint
+    path) pairs every primary replica with a drafter engine built from
+    the cheap model's spec: draft-``draft_k`` / verify-1 speculative
+    decoding at bitwise greedy parity.  The drafter is always a
+    :class:`PagedDecodeEngine` with ``prefix_share=False`` (its cold
+    re-prefill rewrites pages in place — shared prefix pages would be
+    corrupted) and mirrors the primary's slots/max_len geometry so slot
+    indices line up pair-wise."""
     import jax
 
     from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, get_or_build_vocab
@@ -312,9 +333,8 @@ def build_decode_pool(args: Args, replicas: int, *,
             groups = [make_mesh(devices=devices[i * per:(i + 1) * per])
                       for i in range(replicas)]
     tok = WordPieceTokenizer(get_or_build_vocab(args))
-    cls = (PagedDecodeEngine
-           if getattr(args, "kv_layout", "paged") != "slots"
-           else DecodeEngine)
+    paged = getattr(args, "kv_layout", "paged") != "slots"
+    cls = PagedDecodeEngine if paged else DecodeEngine
     engines = [cls(args, tokenizer=tok, mesh=groups[i],
                    buckets=buckets) for i in range(replicas)]
     tracer = engines[0].tracer
@@ -330,8 +350,40 @@ def build_decode_pool(args: Args, replicas: int, *,
     else:
         rank0_print("WARNING: no checkpoint found — decoding from "
                     "untrained init weights (smoke mode)", file=sys.stderr)
+    drafters = None
+    if speculate:
+        import dataclasses
+
+        from pdnlp_tpu.serve import parse_speculate_spec
+
+        if not paged:
+            sys.exit("serve_tpu: --speculate needs --kv_layout paged "
+                     "(draft custody lives in the page table)")
+        dspec = parse_speculate_spec(speculate)
+        # the drafter serves its own architecture/precision — one Args
+        # copy per spec, exactly the fleet's per-model pattern; the
+        # bare-checkpoint form inherits the primary's architecture (a
+        # distilled same-shape checkpoint)
+        dargs = args
+        if "=" in speculate:
+            dargs = dataclasses.replace(args, model=dspec.model_id)
+        if dspec.dtype != "auto":
+            dargs = dataclasses.replace(dargs, serve_dtype=dspec.dtype)
+        drafters = [PagedDecodeEngine(
+            dargs, tokenizer=tok, mesh=groups[i], buckets=buckets,
+            tracer=tracer, slots=engines[i].slots,
+            max_len=engines[i].max_len, prefix_share=False)
+            for i in range(replicas)]
+        if dspec.checkpoint:
+            for d in drafters:
+                d.load_checkpoint(dspec.checkpoint)
+        rank0_print(f"speculating: drafter {dspec.model_id} "
+                    f"({dspec.checkpoint or '<init weights>'} "
+                    f"[{dspec.dtype}]) drafts k={draft_k} per round",
+                    file=sys.stderr)
     return DecodeRouter(engines, max_waiting=max_waiting,
-                        default_max_new=args.max_new_tokens)
+                        default_max_new=args.max_new_tokens,
+                        drafters=drafters, draft_k=draft_k)
 
 
 def serve_decode(args: Args, argv_flags: dict) -> None:
@@ -353,7 +405,9 @@ def serve_decode(args: Args, argv_flags: dict) -> None:
         args, argv_flags["replicas"],
         checkpoint=argv_flags["checkpoint"],
         use_mesh=argv_flags["use_mesh"], buckets=argv_flags["buckets"],
-        max_waiting=argv_flags["max_queue"])
+        max_waiting=argv_flags["max_queue"],
+        speculate=argv_flags.get("speculate"),
+        draft_k=argv_flags.get("draft_k", 4))
     engine = pool.engine(0)
     pool.start()
     pool.warmup()
@@ -361,14 +415,36 @@ def serve_decode(args: Args, argv_flags: dict) -> None:
                 "tokens stream as `<line>\\ttok\\t<piece>`",
                 file=sys.stderr)
 
+    # the decode control plane: with --controller on, the speculation law
+    # adapts draft_k to the live acceptance rate (and switches a wasteful
+    # drafter off) through the same decision-recorded _actuate path the
+    # classification pool's knobs ride
+    controller = None
+    if argv_flags.get("controller", "off") not in ("off", "false", "0",
+                                                   None):
+        from pdnlp_tpu.serve.controller import ServeController
+
+        controller = ServeController(pool, tracer=engine.tracer)
+        controller.start()
+        rank0_print("[controller] decode control plane on (speculation "
+                    "law adapts draft_k; trace_tpu.py decisions)",
+                    file=sys.stderr)
+
     exporter = None
     if args.metrics_port or args.flight_recorder:
         from pdnlp_tpu.obs import memory_snapshot
         from pdnlp_tpu.obs.exporter import build_from_args
 
+        sources = {"decode": pool.snapshot, "memory": memory_snapshot}
+        # acceptance at a glance on /healthz (the probe a load balancer
+        # reads); the full per-model speculation block rides /metrics
+        # via the snapshot's by_model labels
+        health = {"decode": pool.health_summary}
+        if controller is not None:
+            sources["controller"] = controller.snapshot
+            health["controller"] = controller.health_summary
         exporter = build_from_args(
-            args, {"decode": pool.snapshot, "memory": memory_snapshot},
-            "flight_decode.jsonl")
+            args, sources, "flight_decode.jsonl", health_sources=health)
 
     tokenizer = engine.tokenizer
     max_new = args.max_new_tokens
@@ -396,6 +472,8 @@ def serve_decode(args: Args, argv_flags: dict) -> None:
     def flush_artifacts() -> None:
         import json
 
+        if controller is not None:
+            controller.stop()
         if exporter is not None:
             exporter.stop(final_flight=True)
         snap = pool.snapshot()
@@ -473,6 +551,8 @@ def main(argv=None) -> None:
                                          float)
     argv, degrade_at = pop_cli_flag(argv, "--degrade_at", None, int)
     argv, rollout_mode = pop_cli_flag(argv, "--rollout", "auto")
+    argv, speculate = pop_cli_flag(argv, "--speculate")
+    argv, draft_k = pop_cli_flag(argv, "--draft_k", 4, int)
     argv, in_path = pop_cli_flag(argv, "--input")
     argv, out_path = pop_cli_flag(argv, "--output")
     argv, metrics_path = pop_cli_flag(argv, "--metrics_path")
@@ -496,8 +576,12 @@ def main(argv=None) -> None:
             "replicas": replicas, "checkpoint": checkpoint,
             "use_mesh": not no_mesh, "buckets": buckets,
             "max_queue": max_queue, "metrics_path": metrics_path,
-            "deadline_ms": deadline,
+            "deadline_ms": deadline, "speculate": speculate,
+            "draft_k": draft_k, "controller": controller_mode,
         })
+    if speculate:
+        sys.exit("serve_tpu: --speculate is the generative path — "
+                 "speculative decoding needs --decode")
     # chunked prefill (--serve_long_widths "512,1024"): single-replica
     # frontend only — the router's queues stay short-width; a long request
     # hitting a router deployment truncates at the largest bucket as before

@@ -1,0 +1,36 @@
+"""R17 negatives: fixed-width speculation dispatch (and lookalikes)."""
+import jax  # noqa: F401
+
+
+def speculate_fixed(draft_step, verify_ids, params, tok, window, kv,
+                    pos, nreal):
+    # the engine spelling: full-width [slots, k+1] dispatch, the runtime
+    # accepted/real length rides the nreal DATA argument the program
+    # masks on — one compile per configured k
+    for _ in range(16):
+        window = draft_step(params, tok, kv)
+        logits = verify_ids(params, window, kv, pos, nreal)
+        nreal = logits.argmax()
+    return window
+
+
+def literal_slice(verify_ids, params, window, kv, pos):
+    # a literal bound is one compile-time shape, not a per-round retrace
+    for _ in range(16):
+        logits = verify_ids(params, window[:, :5], kv, pos)
+        window = logits
+    return window
+
+
+def non_spec_slice(decode_step, normalize, params, tok, kv, m):
+    # a runtime slice on a NON-speculation call in a decode loop is some
+    # other rule's business, not a speculative-shape hazard
+    for _ in range(16):
+        tok = decode_step(params, normalize(tok[:, :m]), kv)
+    return tok
+
+
+def outside_decode_loop(verify_ids, params, window, kv, pos, a):
+    # a one-off variable-width verify outside any decode loop compiles
+    # once per call site, not per generated round
+    return verify_ids(params, window[:, : a + 1], kv, pos)

@@ -1,0 +1,25 @@
+"""R17 positives: speculation dispatch whose shape follows runtime k."""
+import jax  # noqa: F401
+
+
+def speculate(draft_step, verify_ids, params, tok, window, kv, pos):
+    a = 0
+    for _ in range(16):
+        window = draft_step(params, tok, kv)
+        logits = verify_ids(params, window[:, : a + 1], kv, pos)
+        a = int(logits.argmax())
+    return window
+
+
+def adaptive_draft(draft_step, params, tok, kv, k):
+    while tok.size:
+        tok = draft_step(params, tok[:, :k], kv)
+        k = max(1, k - 1)
+    return tok
+
+
+def verify_tail(verify_chunk, window, kv, start, end):
+    for _ in range(8):
+        window = verify_chunk(window[:, start:end], kv)
+        start = end
+    return window

@@ -401,6 +401,45 @@ def test_r16_hint_names_the_fix():
     assert "dynamic_update_slice" in f.hint
 
 
+def test_r17_spec_retrace_positive():
+    # verify window sliced to the runtime accepted length (9), draft
+    # window sliced to an adaptive k (16), verify sliced to runtime
+    # start:end bounds (23) — each inside a decode-shaped loop
+    assert all_hits("r17_pos.py") == [("R17", 9), ("R17", 16),
+                                      ("R17", 23)]
+
+
+def test_r17_spec_retrace_negative():
+    # full-width dispatch with the real length as masked data (the
+    # engine spelling), literal-bound slices, runtime slices on
+    # non-speculation calls, and variable-width verify OUTSIDE a decode
+    # loop all stay clean
+    assert hits("r17_neg.py", "R17") == []
+
+
+def test_r17_requires_decode_loop(tmp_path):
+    """A variable-width verify in a plain data loop is a one-off shape
+    per call site, not a per-round retrace — the loop must dispatch a
+    decode/speculation-shaped call."""
+    p = tmp_path / "plain.py"
+    p.write_text("import jax\n"
+                 "def score(batches, verify_ids, params, kv, a):\n"
+                 "    out = []\n"
+                 "    for b in batches:\n"
+                 "        out.append(len(b))\n"
+                 "    return verify_ids(params, kv[:, : a + 1])\n")
+    assert [f for f in analyze_paths([str(p)], root=str(tmp_path))
+            if f.rule_id == "R17"] == []
+
+
+def test_r17_hint_names_the_fix():
+    path = os.path.join(FIXTURES, "r17_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R17"][0]
+    assert "verify_ids" in f.hint
+    assert "data argument" in f.hint
+
+
 # ------------------------------------------------- concurrency suite (T1-T3)
 
 def test_t1_unguarded_attr_positive():
@@ -683,12 +722,12 @@ def test_findings_carry_exact_location_and_hint():
 
 def test_rule_registry_complete():
     # the registry sorts by id STRING (the lifecycle suite's L1-L4
-    # before the R's; R10..R16 between R1 and R2; the concurrency
+    # before the R's; R10..R17 between R1 and R2; the concurrency
     # suite's T1-T3 after the R's)
     assert list(all_rules()) == ["L1", "L2", "L3", "L4",
                                  "R1", "R10", "R11", "R12", "R13", "R14",
-                                 "R15", "R16", "R2", "R3", "R4", "R5",
-                                 "R6", "R7", "R8", "R9",
+                                 "R15", "R16", "R17", "R2", "R3", "R4",
+                                 "R5", "R6", "R7", "R8", "R9",
                                  "T1", "T2", "T3"]
     suites = {rid: r.suite for rid, r in all_rules().items()}
     assert all(s == "concurrency" for rid, s in suites.items()
