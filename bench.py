@@ -250,6 +250,18 @@ def decode_smoke(argv) -> None:
       real acceptance rate), calibrated per host: every primary
       dispatch is padded to the MEASURED per-step cost of a real
       bert-small engine while the drafter runs bert-tiny at full speed.
+    - **disaggregated pools** (phase F, ROADMAP item 4): the same mixed
+      storm through an interleaved single-engine batcher and through a
+      3-engine prefill/decode pool split (socket transport), with every
+      prefill dispatch padded by a fixed cost on BOTH setups.  Gates:
+      the interleaved inter-token p99 must inherit the prefill cost
+      while the decode pool's p99 stays under it (the isolation claim),
+      bitwise token parity between the two setups, zero post-warmup
+      retraces across all four engines, complete hop chains with every
+      stream crossing the pool boundary exactly through a ``handoff``
+      hop, zero wire-frame errors, and — through a mid-storm decode-
+      replica kill — requeued orphans that re-home through the front
+      door at exact-token parity with reconciled survivor page ledgers.
 
     Deterministic and CPU-safe (seeded prompts over a synthetic vocab,
     greedy decode, EOS disabled so token counts are exact); snapshot at
@@ -268,6 +280,7 @@ def decode_smoke(argv) -> None:
         DecodeBatcher, DecodeEngine, DecodeRouter, PagedDecodeEngine,
         ServeController,
     )
+    from pdnlp_tpu.serve.decode import DisaggDecodeRouter
     from pdnlp_tpu.utils.config import Args, parse_cli, pop_cli_flag
 
     argv, n_streams = pop_cli_flag(argv, "--decode_streams", 48, int)
@@ -736,6 +749,134 @@ def decode_smoke(argv) -> None:
         [s.rid for s in sstreams] + [s.rid for s in ckstreams])
     sp_decisions = validate_decisions(srecords)
 
+    # --------------------- phase F: disaggregated prefill/decode pools
+    # The isolation claim (ROADMAP item 4, DistServe/Splitwise): when
+    # prefill is expensive, interleaving it with decode on ONE engine
+    # stalls every live stream for the full prefill cost, so the
+    # inter-token tail inherits that cost; a prefill pool handing
+    # finished pages to a decode pool moves the work off the decode
+    # path — decode units only IMPORT pages (a cheap fixed-shape
+    # scatter), so their tail stays flat.  As in phase E the cost is
+    # synthetic but honest: every prefill dispatch is padded by a fixed
+    # df_pad_s AFTER warmup, on BOTH setups, and the storm is the same
+    # on both — mixed prompt lengths with a per-stream max_new spread,
+    # so completions desynchronise and admissions land mid-decode (the
+    # interleaved engine then cannot hide the prefill behind idle
+    # slots).  Socket transport: the wire framing is part of the
+    # measured decode-pool path, not a best case.
+    df_pad_s = 0.05
+    df_n = 32
+    df_prompts = prompts[:df_n]
+    df_max_new = [int(x) for x in rng.integers(8, max_new + 1, df_n)]
+
+    def pad_prefill(engine):
+        # after warmup, like pad_primary: compile time and the
+        # retrace/miss ledgers stay untouched, only dispatch wall time
+        for name in ("prefill_ids", "prefill_chunk"):
+            orig = getattr(engine, name)
+
+            def padded(*a, _orig=orig, **kw):
+                out = _orig(*a, **kw)
+                time.sleep(df_pad_s)
+                return out
+            setattr(engine, name, padded)
+
+    dargs = parse_cli([], base=Args(
+        model="bert-tiny", decode_slots=pd_slots,
+        decode_max_len=pd_max_len, max_new_tokens=max_new,
+        kv_page_sz=pd_page_sz, seed=args.seed, trace=True,
+        trace_dir=trace_dir))
+
+    # F1 — interleaved control: one paged engine doing both jobs.  Its
+    # outputs are also the parity reference (greedy decode is weight-
+    # deterministic; the pools must reproduce it token for token).
+    il_eng = PagedDecodeEngine(dargs, tokenizer=tok, mesh=None,
+                               buckets=buckets)
+    il_b = DecodeBatcher(il_eng, max_waiting=df_n).start()
+    il_b.eos_id = -1
+    il_b.warmup()
+    il_r0 = il_eng.metrics.retraces.value
+    il_m0 = il_eng.metrics.cache_misses.value
+    pad_prefill(il_eng)
+    il_streams = [il_b.submit_ids(p, max_new_tokens=mn)
+                  for p, mn in zip(df_prompts, df_max_new)]
+    il_outs = [s.result(timeout=600) for s in il_streams]
+    il_snap = il_b.snapshot()
+    il_b.stop()
+    il_retraces = il_eng.metrics.retraces.value - il_r0
+    il_misses = il_eng.metrics.cache_misses.value - il_m0
+    il_leak = il_eng.leak_check()
+    il_itok_p50 = il_snap["decode"]["intertoken_ms"]["p50"]
+    il_itok_p99 = il_snap["decode"]["intertoken_ms"]["p99"]
+
+    # F2 — the pool split: 1 prefill + 2 decode engines, same storm
+    dengines = [PagedDecodeEngine(dargs, tokenizer=tok, mesh=None,
+                                  buckets=buckets) for _ in range(3)]
+    for e in dengines[1:]:
+        e.tracer = dengines[0].tracer
+    drouter = DisaggDecodeRouter(dengines, prefill_engines=1,
+                                 max_waiting=df_n,
+                                 transport="socket").start()
+    for u in drouter._units:
+        u.eos_id = -1
+    drouter.warmup()
+    df_r0 = sum(e.metrics.retraces.value for e in dengines)
+    df_m0 = sum(e.metrics.cache_misses.value for e in dengines)
+    for e in dengines:
+        pad_prefill(e)  # decode units never call these — the point
+    df_streams = [drouter.submit_ids(p, max_new_tokens=mn)
+                  for p, mn in zip(df_prompts, df_max_new)]
+    df_outs = [s.result(timeout=600) for s in df_streams]
+    # snapshot BEFORE the kill leg: the isolation numbers are the
+    # healthy storm's; PrefillWorker never records inter-token gaps, so
+    # the merged latency block IS the decode pool's histogram
+    df_snap = drouter.control_snapshot()
+    df_itok_p50 = df_snap["latency"]["inter_token_p50_ms"]
+    df_itok_p99 = df_snap["latency"]["inter_token_p99_ms"]
+    df_ttft_p99 = df_snap["latency"]["ttft_p99_ms"]
+    df_frames_ok = sum(s.frames_ok for s in drouter._servers.values())
+    df_frames_err = sum(s.frames_err for s in drouter._servers.values())
+    df_parity = df_outs == il_outs
+
+    # F3 — mid-storm decode-replica kill on the WARM router (the prefix
+    # index is hot from F2, so re-submitted prompts take the full-hit
+    # handoff path: COW-source custody rides the boundary too).  The
+    # victim's orphans re-home through the front door — re-prefill,
+    # second handoff — and must still emit exactly the reference tokens.
+    dk_n = 24
+    dk_v0 = int(drouter._units[1].metrics.tokens_out_total.value)
+    dk_streams = [drouter.submit_ids(p, max_new_tokens=mn)
+                  for p, mn in zip(df_prompts[:dk_n], df_max_new[:dk_n])]
+    deadline = time.monotonic() + 120
+    while (int(drouter._units[1].metrics.tokens_out_total.value)
+           < dk_v0 + 5 and time.monotonic() < deadline):
+        time.sleep(0.002)
+    drouter.kill(1, RuntimeError("bench decode-pool chaos"))
+    dk_outs = [s.result(timeout=600) for s in dk_streams]
+    dk_parity = dk_outs == il_outs[:dk_n]
+    df_retraces = sum(e.metrics.retraces.value for e in dengines) - df_r0
+    df_misses = (sum(e.metrics.cache_misses.value for e in dengines)
+                 - df_m0)
+    df_health = drouter.health_summary()
+    drouter.stop()
+    # survivor ledgers only: the victim's allocator died with its cache
+    # (the established kill contract — see the paged kill storm above)
+    df_leaks = {i: dengines[i].leak_check() for i in (0, 2)}
+    df_clean = all(lk["ok"] and not lk["stream_owners"]
+                   for lk in list(df_leaks.values()) + [il_leak])
+
+    # pool-boundary chain integrity through the FILE round trip
+    df_path = dengines[0].tracer.flush()
+    dfrecords = []
+    with open(df_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                dfrecords.append(json.loads(line))
+    df_report = validate_chains(
+        dfrecords,
+        [s.rid for s in df_streams] + [s.rid for s in dk_streams])
+
     # ------------------------------------------------------------- gates
     if speedup < 2.0:
         failures.append(f"decode tokens/s/chip only {speedup:.2f}x the "
@@ -834,6 +975,50 @@ def decode_smoke(argv) -> None:
     if sp_decisions["by_knob"].get("draft_k", 0) < 3:
         failures.append("fewer than 3 draft_k decisions recorded — the "
                         "adaptation demo did not go through _actuate")
+    df_pad_ms = df_pad_s * 1e3
+    if il_itok_p99 is None or il_itok_p99 < df_pad_ms:
+        failures.append(
+            f"interleaved control inter-token p99 {il_itok_p99} ms never "
+            f"inherited the {df_pad_ms:.0f} ms prefill pad — the "
+            "isolation comparison measured nothing")
+    if df_itok_p99 is None or df_itok_p99 >= df_pad_ms:
+        failures.append(
+            f"disaggregated decode-pool inter-token p99 {df_itok_p99} ms "
+            f"not isolated from the {df_pad_ms:.0f} ms prefill pad "
+            "(gate: decode units must never eat a prefill)")
+    if not df_parity:
+        failures.append("disaggregated storm diverged from the "
+                        "interleaved reference (pool split must be "
+                        "token-invisible)")
+    if not dk_parity:
+        failures.append("decode-replica kill duplicated or lost tokens "
+                        "(re-homed orphans must match the interleaved "
+                        "reference)")
+    if df_retraces != 0 or df_misses != 0 or il_retraces != 0 \
+            or il_misses != 0:
+        failures.append(
+            f"disagg phase retraced post-warmup (pools {df_retraces}/"
+            f"{df_misses}, interleaved {il_retraces}/{il_misses}; "
+            "gate: 0 — every engine warms both roles)")
+    if df_frames_err != 0 or df_frames_ok < df_n:
+        failures.append(
+            f"socket handoff frames ok={df_frames_ok} err="
+            f"{df_frames_err} (gate: every healthy-storm stream crosses "
+            "the wire cleanly)")
+    if df_report["incomplete"]:
+        failures.append(f"{len(df_report['incomplete'])} incomplete hop "
+                        "chains through the disaggregated storms")
+    if df_report["handed_off"] != df_n + dk_n:
+        failures.append(
+            f"{df_report['handed_off']}/{df_n + dk_n} chains crossed "
+            "the pool boundary via a handoff hop (gate: all of them)")
+    if df_report["requeued"] < 1 or df_report["re_prefilled"] < 1:
+        failures.append("the decode-pool kill never requeued/"
+                        "re-prefilled a stream — the recovery leg "
+                        "proved nothing")
+    if not df_clean:
+        failures.append("disagg phase leaked pages: "
+                        f"survivors={df_leaks} interleaved={il_leak}")
 
     result = {
         "metric": "decode_smoke",
@@ -935,6 +1120,38 @@ def decode_smoke(argv) -> None:
                 "decisions_by_knob": sp_decisions["by_knob"],
             },
         },
+        "disaggregation": {
+            "engines": len(dengines),
+            "pools": df_snap["by_pool"],
+            "transport": "socket",
+            "streams": df_n,
+            "prefill_pad_ms": round(df_pad_ms, 1),
+            "interleaved_intertoken_ms_p50": il_itok_p50,
+            "interleaved_intertoken_ms_p99": il_itok_p99,
+            "decode_pool_intertoken_ms_p50": df_itok_p50,
+            "decode_pool_intertoken_ms_p99": df_itok_p99,
+            "decode_pool_ttft_ms_p99": df_ttft_p99,
+            "isolation_gain_p99": round(
+                il_itok_p99 / df_itok_p99, 2) if df_itok_p99 else None,
+            "token_parity_with_interleaved": bool(df_parity),
+            "frames_ok": int(df_frames_ok),
+            "frames_err": int(df_frames_err),
+            "retraces_post_warmup": int(df_retraces),
+            "handoffs": int(df_health["handoffs"]),
+            "handoff_failures": int(df_health["handoff_failures"]),
+            "chains": {"checked": df_report["checked"],
+                       "complete": df_report["complete"],
+                       "handed_off": df_report["handed_off"],
+                       "requeued": df_report["requeued"],
+                       "re_prefilled": df_report["re_prefilled"]},
+            "kill": {
+                "victim_pool": "decode",
+                "streams": dk_n,
+                "token_parity_with_interleaved": bool(dk_parity),
+            },
+            "survivor_leak_checks": {str(i): lk
+                                     for i, lk in df_leaks.items()},
+        },
         "p99_budget_ms": p99_budget,
         "model": args.model,
         "kv_dtype": engine.kv_snapshot()["kv_dtype"],
@@ -971,6 +1188,23 @@ def decode_smoke(argv) -> None:
             "spec_decision_chains_complete": bool(
                 not sp_decisions["incomplete"]
                 and sp_decisions["by_knob"].get("draft_k", 0) >= 3),
+            "disagg_decode_p99_isolated": bool(
+                il_itok_p99 is not None and df_itok_p99 is not None
+                and il_itok_p99 >= df_pad_ms
+                and df_itok_p99 < df_pad_ms),
+            "disagg_token_parity": bool(df_parity and dk_parity),
+            "disagg_zero_post_warmup_retraces": bool(
+                df_retraces == 0 and df_misses == 0
+                and il_retraces == 0 and il_misses == 0),
+            "disagg_wire_frames_clean": bool(
+                df_frames_err == 0 and df_frames_ok >= df_n),
+            "disagg_chains_complete_all_handed_off": bool(
+                not df_report["incomplete"]
+                and df_report["handed_off"] == df_n + dk_n),
+            "disagg_kill_requeues_through_front_door": bool(
+                df_report["requeued"] >= 1
+                and df_report["re_prefilled"] >= 1),
+            "disagg_zero_leaked_pages": bool(df_clean),
         },
         "failures": failures,
     }
@@ -982,7 +1216,8 @@ def decode_smoke(argv) -> None:
         os.replace(tmp, out_path)
     print(json.dumps({k: v for k, v in result.items()
                       if k not in ("decode", "reprefill_baseline",
-                                   "paged_storm", "speculation")}))
+                                   "paged_storm", "speculation",
+                                   "disaggregation")}))
     if failures:
         sys.exit("decode smoke FAILED:\n  - " + "\n  - ".join(failures)
                  + f"\n  see {out_path}")

@@ -63,6 +63,12 @@ ACQUIRE_REGISTRY: Tuple[AcquireSpec, ...] = (
         kind="kv-pages",
         methods=_fs("alloc", "share"),
         arg_methods=_fs("share"),
+        # ``transfer`` discharges the SENDER side of a custody move; the
+        # disaggregation staging wrapper ``stage_handoff`` (which calls
+        # transfer onto the staged owner and returns that key) CREATES
+        # the receiver-side obligation — its result owes a
+        # release_owner on every dispatch outcome
+        funcs=_fs("pdnlp_tpu.serve.kvpage.stage_handoff"),
         releasers=_fs("release", "release_owner", "release_if_idle",
                       "transfer"),
         recv_types=_fs("PageAllocator"),
@@ -70,6 +76,17 @@ ACQUIRE_REGISTRY: Tuple[AcquireSpec, ...] = (
         hint="release/release_owner the pages on every exit (wrap the "
              "post-acquire tail in try/except BaseException), or commit "
              "them into the page table / a ledger before anything can "
+             "raise",
+    ),
+    AcquireSpec(
+        kind="handoff-conn",
+        methods=_fs(),
+        funcs=_fs("pdnlp_tpu.serve.handoff.HandoffChannel",
+                  "socket.create_connection"),
+        releasers=_fs("close"),
+        hint="close the handoff channel/socket on every path "
+             "(try/finally or use it as a context manager), or commit "
+             "it into the router's channel table before anything can "
              "raise",
     ),
     AcquireSpec(

@@ -21,4 +21,5 @@ from pdnlp_tpu.analysis.rules import (  # noqa: F401
     r15_unrecorded_traffic_shift,
     r16_kv_realloc,
     r17_spec_retrace,
+    r18_handoff_retrace,
 )

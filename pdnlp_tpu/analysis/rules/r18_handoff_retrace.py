@@ -1,0 +1,158 @@
+"""R18 — handoff export/import dispatch whose shape follows the live
+page count.
+
+The disaggregated KV handoff (``pdnlp_tpu.serve.decode`` — prefill-role
+export, decode-role import) stays retrace-free by CONSTRUCTION: both
+programs take the FULL ``[pages_per_stream]`` table row, sentinel-padded
+past the stream's real pages, so ONE compiled export and ONE compiled
+import serve every stream regardless of prompt length.  The tempting
+spelling inverts that::
+
+    pages = [p for p in table[slot] if p < n_pages]
+    k, v = export_fn(cache_k, cache_v, np.asarray(pages))      # <- R18
+    import_fn(cache_k, cache_v, pk, pv, dst[:len(pages)])      # <- R18
+
+Sizing the gather/scatter index array to the runtime page count hands
+jit a DIFFERENT shape for every distinct prompt-length bucket a stream
+lands in — a handoff storm then compiles per page-count instead of
+hitting the one warmed program, and TTFT eats the XLA queue.  The fix
+is the engine's: dispatch the padded full-width row and let the program
+drop sentinel rows internally (the real count rides as masked data).
+
+Heuristic, per function: a HANDOFF dispatch — a call whose name's last
+segment contains ``export``/``import`` — with an argument that is
+(a) a subscript SLICE whose bound is not a compile-time constant
+(``dst[:n_live]``, ``row[: len(pages)]``), or (b) a name bound to a
+comprehension/``filter`` in the same function (the live-page list),
+bare or wrapped in ``asarray``/``array``/``stack``/``concatenate``.
+Full-width rows, sentinel ``np.full`` padding, literal-bound slices,
+and runtime counts passed as scalar data (``len(pages)`` as an
+argument) never match.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Set
+
+from pdnlp_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, dotted_name, register,
+)
+
+_HANDOFF_CALL_RE = re.compile(r"(export|import)", re.I)
+_WRAP_FUNCS = frozenset(("asarray", "array", "stack", "concatenate"))
+
+
+@register
+class PerStreamHandoffRetrace(Rule):
+    rule_id = "R18"
+    name = "per-stream-handoff-retrace"
+    hint = ("dispatch the handoff export/import at the FULL fixed "
+            "[pages_per_stream] table extent, sentinel-padded past the "
+            "stream's live pages (pdnlp_tpu.serve.decode export_pages/"
+            "import_pages are the engine forms — compile keys "
+            "('export'|'import', pages_per_stream)) — sizing the index "
+            "array to the runtime page count gives every prompt-length "
+            "bucket its own program shape, so a handoff storm compiles "
+            "per page-count instead of reusing the one warmed program")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not self._relevant(mod):
+            return
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            varlen = self._varlen_names(fn)
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call) \
+                        or not self._is_handoff_dispatch(call):
+                    continue
+                if self._has_runtime_slice(call) \
+                        or self._passes_varlen_array(call, varlen):
+                    yield self.finding(
+                        mod, call,
+                        "handoff export/import dispatched with a "
+                        "runtime-page-count shape — every distinct live "
+                        "page count is a new program, so the handoff "
+                        "path retraces per prompt-length bucket instead "
+                        "of reusing the one fixed [pages_per_stream] "
+                        "padded program")
+
+    @staticmethod
+    def _relevant(mod: ModuleInfo) -> bool:
+        return "jax" in mod.aliases or any(
+            a.startswith("jax") for a in mod.aliases.values())
+
+    @staticmethod
+    def _is_handoff_dispatch(call: ast.Call) -> bool:
+        name = dotted_name(call.func)
+        if not name:
+            return False
+        return bool(_HANDOFF_CALL_RE.search(name.split(".")[-1]))
+
+    @staticmethod
+    def _varlen_names(fn: ast.AST) -> Set[str]:
+        """Names bound (in this function) to a value whose LENGTH only
+        runtime knows: a comprehension, a ``filter(...)`` call, or a
+        ``list(...)`` wrapping either."""
+        def varlen_value(v: ast.AST) -> bool:
+            if isinstance(v, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+                return True
+            if isinstance(v, ast.Call):
+                fname = dotted_name(v.func) or ""
+                last = fname.split(".")[-1]
+                if last == "filter":
+                    return True
+                if last == "list" and v.args \
+                        and varlen_value(v.args[0]):
+                    return True
+            return False
+
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and varlen_value(node.value):
+                out |= {t.id for t in node.targets
+                        if isinstance(t, ast.Name)}
+        return out
+
+    @staticmethod
+    def _has_runtime_slice(call: ast.Call) -> bool:
+        """Any argument subscripted with a Slice whose bound contains an
+        identifier — a extent only runtime knows (R17's test)."""
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for node in ast.walk(arg):
+                if not isinstance(node, ast.Subscript):
+                    continue
+                sl = node.slice
+                parts = [sl] if isinstance(sl, ast.Slice) else [
+                    d for d in getattr(sl, "elts", [])
+                    if isinstance(d, ast.Slice)]
+                for dim in parts:
+                    for bound in (dim.lower, dim.upper, dim.step):
+                        if bound is None:
+                            continue
+                        if any(isinstance(n, ast.Name)
+                               for n in ast.walk(bound)):
+                            return True
+        return False
+
+    @staticmethod
+    def _passes_varlen_array(call: ast.Call, varlen: Set[str]) -> bool:
+        """An argument that IS a live-page list (or an array built from
+        one): a bare varlen name, an inline comprehension, or an
+        asarray/array/stack/concatenate over either.  A varlen name
+        buried in other calls (``len(pages)``) is scalar DATA — the
+        sanctioned spelling — and never matches."""
+        def is_varlen_expr(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in varlen
+            if isinstance(e, (ast.ListComp, ast.GeneratorExp)):
+                return True
+            if isinstance(e, ast.Call):
+                fname = dotted_name(e.func) or ""
+                if fname.split(".")[-1] in _WRAP_FUNCS:
+                    return any(is_varlen_expr(a) for a in e.args)
+            return False
+
+        return any(is_varlen_expr(a) for a in
+                   list(call.args) + [kw.value for kw in call.keywords])

@@ -311,6 +311,37 @@ def copy_pages(pages_k: jax.Array, pages_v: jax.Array,
     return pages_k, pages_v
 
 
+def gather_pages(pages_k: jax.Array, pages_v: jax.Array,
+                 src: jax.Array       # [rows] physical page ids (OOB = 0s)
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Export one stream's pages into a dense ``[L, rows, page_sz, N, D]``
+    payload for a KV handoff.  ``src`` is ALWAYS the fixed
+    ``pages_per_stream`` extent, padded with the OOB sentinel ``P``
+    (``mode="fill"`` reads zeros there), so one compiled program serves
+    every stream regardless of how many pages it actually holds — the
+    real page count rides the page ids, never the shape."""
+    out_k = jnp.take(pages_k, src, axis=1, mode="fill", fill_value=0)
+    out_v = jnp.take(pages_v, src, axis=1, mode="fill", fill_value=0)
+    return out_k, out_v
+
+
+def scatter_pages(pages_k: jax.Array, pages_v: jax.Array,
+                  payload_k: jax.Array,  # [L, rows, page_sz, N, D]
+                  payload_v: jax.Array,
+                  dst: jax.Array         # [rows] physical page ids (OOB drop)
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Import a handoff payload into freshly-allocated pages: the receive
+    half of :func:`gather_pages`.  ``dst`` rows past the stream's real
+    page count carry the OOB sentinel ``P`` and their (zero-filled)
+    payload rows are dropped, so the import is the same ONE fixed-shape
+    program for every stream."""
+    pages_k = pages_k.at[:, dst].set(payload_k.astype(pages_k.dtype),
+                                     mode="drop")
+    pages_v = pages_v.at[:, dst].set(payload_v.astype(pages_v.dtype),
+                                     mode="drop")
+    return pages_k, pages_v
+
+
 def _flat_gather_idx(page_table: jax.Array, page_sz: int) -> jax.Array:
     """[B, MP] page table -> [B, MP * page_sz] flat gather indices.
     Sentinel table entries (>= P) map past the flat extent and read 0."""

@@ -45,8 +45,11 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 #: segment even when they are not integer-like — the fleet's per-model
 #: blocks (``models`` / ``by_model`` keyed by model id) must scrape as
 #: ``{model="primary"}`` so one dashboard query compares
-#: primary/candidate/cheap tiers instead of matching N metric names
-_LABELED_CONTAINERS = {"models": "model", "by_model": "model"}
+#: primary/candidate/cheap tiers instead of matching N metric names —
+#: and the disaggregated router's role blocks (``by_pool`` keyed by
+#: ``prefill``/``decode``) scrape as ``{pool="prefill"}`` the same way
+_LABELED_CONTAINERS = {"models": "model", "by_model": "model",
+                       "by_pool": "pool"}
 
 
 def _metric_name(*parts: str) -> str:

@@ -78,6 +78,18 @@ hop                   meaning / extra attrs
                       (``decode*`` may be empty: a stream whose first
                       token is EOS or whose budget is 1 completes
                       straight from prefill)
+``handoff``           disaggregated pools: the stream's prefilled KV
+                      pages moved from a prefill-role engine to a
+                      decode-role engine (``from_replica``,
+                      ``to_replica``, ``pages``, ``bytes``,
+                      ``transport`` — ``local`` or ``socket``).
+                      Recorded per placement attempt BEFORE the seat
+                      (ordering: the receiver may decode-complete the
+                      stream immediately).  A disaggregated chain is
+                      ``admit → prefill → handoff → decode* →
+                      complete``; a failed dispatch re-prefills at the
+                      sender, so ``prefill → handoff → prefill →
+                      handoff → …`` is legal recovery
 ``draft``             speculative decoding: the cheap drafter proposed
                       ``k`` tokens for this stream's next positions
                       through its own paged KV cache (``slot``, ``k``,
@@ -247,6 +259,11 @@ def chain_issues(chain: Sequence[Dict]) -> List[str]:
             issues.append("'decode' hop with no earlier 'prefill' — the "
                           "stream decoded from a cache slot no prefill "
                           "filled")
+    if "handoff" in hops:
+        first_handoff = hops.index("handoff")
+        if "prefill" not in hops[:first_handoff]:
+            issues.append("'handoff' hop with no earlier 'prefill' — no "
+                          "prefilled pages existed to hand off")
     if "draft" in hops or "verify" in hops:
         for i, h in enumerate(hops):
             if h == "verify" and (i == 0 or hops[i - 1] != "draft"):
@@ -315,7 +332,7 @@ def validate_chains(records: Sequence[Dict],
     report = {"checked": len(ids), "complete": 0, "incomplete": {},
               "requeued": 0, "repacked": 0, "hedged": 0,
               "shadowed": 0, "degraded": 0, "rolled_back": 0,
-              "streamed": 0, "re_prefilled": 0,
+              "streamed": 0, "re_prefilled": 0, "handed_off": 0,
               "speculated": 0, "accept_rate": None}
     drafted = accepted = 0
     for rid in ids:
@@ -344,6 +361,8 @@ def validate_chains(records: Sequence[Dict],
             report["streamed"] += 1
         if prefills > 1:  # a requeued stream re-prefilled on a survivor
             report["re_prefilled"] += 1
+        if any(h.get("hop") == "handoff" for h in hops):
+            report["handed_off"] += 1  # crossed the disagg pool boundary
         drafts = [h for h in hops if h.get("hop") == "draft"]
         if drafts:
             report["speculated"] += 1

@@ -34,9 +34,17 @@ requests) — this package applies the same treatment to inference:
   or slot-indexed donated KV cache (optionally int8 against calibrated
   per-channel scale tables), bucketed prefill / one fixed-shape decode
   step, continuous batching with streaming responses, a declared KV HBM
-  budget (``--kv_hbm_mb``), and a decode replica router whose
+  budget (``--kv_hbm_mb``), a decode replica router whose
   kill-recovery re-prefills orphan streams on survivors
-  (``serve_tpu.py --decode``);
+  (``serve_tpu.py --decode``), and a :class:`DisaggDecodeRouter` that
+  splits a paged fleet into prefill-role and decode-role engine pools
+  with an audited KV page handoff and a live controller-driven pool
+  split (``--disagg local|socket``);
+- :mod:`pdnlp_tpu.serve.handoff` — the handoff wire: length-prefixed,
+  CRC-checked socket framing (:class:`HandoffServer` /
+  :class:`HandoffChannel`, per-frame acks, torn frames NACKed) moving
+  exported page payloads between the disaggregated pools — the
+  single-host rehearsal of a cross-process serving tier;
 - :mod:`pdnlp_tpu.serve.kvpage` — the paged KV memory subsystem behind
   ``--kv_layout paged``: refcounted fixed-size page allocator with a
   free list, loud :class:`KVPagesExhausted` refusals, a leak-check
@@ -53,7 +61,7 @@ from pdnlp_tpu.serve.batcher import (  # noqa: F401
 from pdnlp_tpu.serve.controller import KnobSpec, ServeController  # noqa: F401
 from pdnlp_tpu.serve.decode import (  # noqa: F401
     DecodeBatcher, DecodeEngine, DecodeRouter, DecodeStream,
-    PagedDecodeEngine,
+    DisaggDecodeRouter, PagedDecodeEngine, PrefillWorker,
 )
 from pdnlp_tpu.serve.engine import InferenceEngine  # noqa: F401
 from pdnlp_tpu.serve.kvpage import (  # noqa: F401

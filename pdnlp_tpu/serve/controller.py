@@ -126,6 +126,17 @@ def default_specs() -> Dict[str, KnobSpec]:
         "draft_k": KnobSpec("draft_k", 0, 8, cooldown_s=5.0,
                             hysteresis=0.0, signal="spec_waste",
                             noise_floor=0.05, integer=True),
+        # the disaggregated pool split (fraction of engines in the
+        # prefill role): the law moves in whole-engine quanta (the
+        # router's prefill_share_step), so hysteresis 0; the actuation
+        # is judged against the signal the DIRECTION it moved puts at
+        # risk (growing prefill starves decode -> inter_token_p99_ms,
+        # shrinking starves prefill -> ttft_p99_ms) — the law passes
+        # the signal explicitly, this default covers injected writes
+        "prefill_share": KnobSpec("prefill_share", 0.1, 0.9,
+                                  cooldown_s=10.0, hysteresis=0.0,
+                                  signal="ttft_p99_ms",
+                                  noise_floor=5.0),
     }
 
 
@@ -148,6 +159,14 @@ class _Sense:
         #: windowed speculative-decoding acceptance (accepted/drafted
         #: over this tick's counter delta; None = no drafting happened)
         self.accept_rate: Optional[float] = kw.get("accept_rate")
+        #: disaggregated pools: the two latency signals the pool-split
+        #: law trades off (blending them into one p99 would hide the
+        #: tradeoff the split exists to move), plus per-pool pressure
+        self.ttft_p99_ms: Optional[float] = kw.get("ttft_p99_ms")
+        self.inter_token_p99_ms: Optional[float] = kw.get(
+            "inter_token_p99_ms")
+        self.prefill_backlog: Optional[float] = kw.get("prefill_backlog")
+        self.decode_backlog: Optional[float] = kw.get("decode_backlog")
         self.knobs: Dict = kw.get("knobs", {})
 
     @property
@@ -232,6 +251,8 @@ class ServeController:
                  accept_floor: float = 0.35,
                  accept_high: float = 0.85,
                  spec_patience: int = 2,
+                 split_patience: int = 2,
+                 split_backlog_min: float = 2.0,
                  scale_patience: int = 3,
                  ewma_alpha: float = 0.4,
                  batch_rows: Optional[int] = None,
@@ -287,6 +308,13 @@ class ServeController:
         self.accept_high = float(accept_high)
         self.spec_patience = int(spec_patience)
         self._spec_low_ticks = 0
+        #: pool-split law: sustained one-sided backlog pressure (at least
+        #: ``split_backlog_min`` more queued streams than the other pool)
+        #: for ``split_patience`` consecutive ticks earns one whole-engine
+        #: re-role; the signed counter means flapping pressure resets it
+        self.split_patience = int(split_patience)
+        self.split_backlog_min = float(split_backlog_min)
+        self._split_ticks = 0
         self.scale_patience = int(scale_patience)
         self.ewma_alpha = float(ewma_alpha)
         self.batch_rows = int(batch_rows
@@ -413,6 +441,10 @@ class ServeController:
         arrived = d["requests"] + d["rejected"]
         per_req = max(1.0, float(arrived))
         lat = r.get("request_latency_ms", {}) or {}
+        # disaggregated routers surface the split latency signals and a
+        # per-pool pressure block; absent on every other router shape
+        lat2 = snap.get("latency") or {}
+        pools = snap.get("by_pool") or {}
         active = snap.get("active",
                           getattr(self.router, "active_count", 1))
         queue_depth = float(r.get("queue_depth", 0.0))
@@ -443,6 +475,10 @@ class ServeController:
             # converges far too slowly for that
             accept_rate=(d["accepted_tokens"] / d["draft_tokens"]
                          if d["draft_tokens"] > 0 else None),
+            ttft_p99_ms=lat2.get("ttft_p99_ms"),
+            inter_token_p99_ms=lat2.get("inter_token_p99_ms"),
+            prefill_backlog=(pools.get("prefill") or {}).get("backlog"),
+            decode_backlog=(pools.get("decode") or {}).get("backlog"),
         )
 
     # --------------------------------------------------------------- decide
@@ -454,6 +490,7 @@ class ServeController:
         self._decide_admission(s, cause)
         self._decide_replicas(s, cause)
         self._decide_speculation(s, cause)
+        self._decide_pool_split(s, cause)
         self._decide_rollout(s, cause)
 
     def _wants(self, knob: str, current, target) -> bool:
@@ -591,6 +628,54 @@ class ServeController:
         if s.accept_rate > self.accept_high \
                 and cur < int(self.specs["draft_k"].hi):
             self._actuate("draft_k", cur + 1, cause)
+
+    def _decide_pool_split(self, s: _Sense, cause: Dict) -> None:
+        """The pool-split law: the controller's first STRUCTURAL knob.
+
+        Dormant unless the router is disaggregated (``prefill_share`` +
+        its quantum ``prefill_share_step`` in the sensed knobs).  The
+        pressure signal is the BACKLOG imbalance — streams queued for a
+        prefill slot vs payloads queued at decode doors — because
+        backlog leads latency: by the time ``ttft_p99`` degrades, the
+        prefill queue has been starved for a full histogram window.
+        Sustained imbalance (``split_backlog_min`` for
+        ``split_patience`` ticks, signed so flapping resets) moves the
+        split ONE engine quantum, through :meth:`_actuate` with the
+        eval signal the move puts at risk: growing the prefill pool is
+        judged against ``inter_token_p99_ms`` (decode lost an engine),
+        shrinking against ``ttft_p99_ms`` — so a re-balance that hurts
+        the side it taxed auto-reverts.  Targets are quantized exactly
+        as the router reports them (``round(cur ± step, 6)``), so the
+        eval window's staleness check compares equal."""
+        cur = s.knobs.get("prefill_share")
+        step = s.knobs.get("prefill_share_step")
+        if cur is None or step is None:
+            return  # not a disaggregated pool
+        pb = s.prefill_backlog
+        db = s.decode_backlog
+        if pb is None and db is None:
+            return
+        pb = float(pb or 0.0)
+        db = float(db or 0.0)
+        spec = self.specs["prefill_share"]
+        if pb >= db + self.split_backlog_min:
+            self._split_ticks = max(0, self._split_ticks) + 1
+            if self._split_ticks >= self.split_patience:
+                self._split_ticks = 0
+                target = round(float(cur) + float(step), 6)
+                if spec.lo <= target <= spec.hi:
+                    self._actuate("prefill_share", target, cause,
+                                  signal="inter_token_p99_ms")
+        elif db >= pb + self.split_backlog_min:
+            self._split_ticks = min(0, self._split_ticks) - 1
+            if -self._split_ticks >= self.split_patience:
+                self._split_ticks = 0
+                target = round(float(cur) - float(step), 6)
+                if spec.lo <= target <= spec.hi:
+                    self._actuate("prefill_share", target, cause,
+                                  signal="ttft_p99_ms")
+        else:
+            self._split_ticks = 0
 
     def _decide_rollout(self, s: _Sense, cause: Dict) -> None:
         """The canary-rollout law: step ``canary_fraction`` up the

@@ -66,11 +66,36 @@ decode batch partially empty (continuous batching).
   decision-recorded); parity is unaffected because the primary cache
   already holds every committed token.
 
+- **disaggregated prefill/decode pools** (:class:`PrefillWorker` +
+  :class:`DisaggDecodeRouter`): the two phases have opposite compute
+  profiles (prefill is FLOP-bound, decode is bandwidth-bound), so one
+  interleaving worker lets a long prefill steal inter-token latency
+  from every live stream.  The disaggregated pool splits the fleet into
+  prefill-role engines (bucketed/chunked prefill only) and decode-role
+  engines (steady fixed-shape decode only); a finished prefill's pages
+  move to a decode engine via the KV **handoff**: a fixed-shape jitted
+  page export (``models.decoder.gather_pages`` over the sentinel-padded
+  table row — one compiled program whatever the stream's real page
+  count), staged custody on the sender
+  (``kvpage.stage_handoff`` — refcounts never blip, both allocators'
+  ``leak_check`` reconcile to zero), and a fixed-shape import
+  (``scatter_pages``) into the receiver's fresh cold reservation.
+  Cross-pool the payload rides ``serve.handoff``'s length-prefixed
+  stdlib-socket transport (loopback; the repo's first RPC boundary).
+  The pool split is the controller's first STRUCTURAL knob
+  (``prefill_share``), actuated through :meth:`DisaggDecodeRouter.
+  set_prefill_share` — a retiring unit hands its streams back through
+  the front door (greedy determinism keeps tokens identical).
+
 Hop chains (``obs.request``): ``admit → prefill → (decode | draft
 verify)* → complete``, with ``decode`` hops carrying
 ``slot``/``step``/``tokens_out`` and speculation rounds carrying
 ``draft``/``verify`` pairs (``k``/``accepted``/``drafter_model``) so
 ``trace_tpu.py request <id>`` reconstructs a stream's whole life.
+Disaggregated streams insert a ``handoff`` hop after their prefill
+(``admit → prefill → handoff → decode* → complete``) carrying the
+custody story (``pages``/``bytes``/``from_replica``/``to_replica``/
+``transport``).
 """
 from __future__ import annotations
 
@@ -94,12 +119,16 @@ from pdnlp_tpu.serve.batcher import (
     usable_buckets,
 )
 from pdnlp_tpu.serve.engine import InferenceEngine
+from pdnlp_tpu.serve.handoff import (
+    HandoffChannel, HandoffError, HandoffServer,
+)
 from pdnlp_tpu.serve.kvpage import (
     INDEX_OWNER, KVPagesExhausted, PageAllocator, PrefixHit, PrefixIndex,
-    draft_owner, pages_needed,
+    draft_owner, pages_needed, stage_handoff,
 )
 from pdnlp_tpu.serve.metrics import DecodeMetrics, ReplicaMetrics
 from pdnlp_tpu.train import checkpoint as ckpt
+from pdnlp_tpu.utils.metrics import merged_percentiles
 
 #: sentinel closing a stream's token queue
 _DONE = object()
@@ -270,10 +299,14 @@ class DecodeEngine(InferenceEngine):
         ``prefix_hit`` attr (None = layout has no prefix sharing)."""
         return None
 
-    def attach_stream(self, slot: int, stream: "DecodeStream"):
+    def attach_stream(self, slot: int, stream: "DecodeStream", *,
+                      share: bool = True):
         """Reserve cache capacity for ``stream`` in ``slot``; returns a
         claim descriptor (None on the slot layout — the slot claim
-        already IS the reservation)."""
+        already IS the reservation).  ``share=False`` forces a COLD
+        claim even when the prefix index would hit: the KV-handoff
+        import path scatters a payload into the reservation, which must
+        never write into shared prefix pages."""
         return None
 
     def detach_slot(self, slot: int) -> None:
@@ -732,11 +765,24 @@ class PagedDecodeEngine(DecodeEngine):
             metrics_ref.retraces.inc()
             return decoder.copy_pages(pk, pv, src, dst)
 
+        def _pexport_fn(pk, pv, src):
+            metrics_ref.retraces.inc()
+            return decoder.gather_pages(pk, pv, src)
+
+        def _pimport_fn(pk, pv, payload_k, payload_v, dst):
+            metrics_ref.retraces.inc()
+            return decoder.scatter_pages(pk, pv, payload_k, payload_v,
+                                         dst)
+
         self._jit_pinsert = jax.jit(_pinsert_fn, donate_argnums=(0, 1))
         self._jit_pdecode = jax.jit(_pdecode_fn, donate_argnums=(2, 3))
         self._jit_pchunk = jax.jit(_pchunk_fn, donate_argnums=(2, 3))
         self._jit_pverify = jax.jit(_pverify_fn, donate_argnums=(2, 3))
         self._jit_pcow = jax.jit(_pcow_fn, donate_argnums=(0, 1))
+        # export reads the pool (no donation — the sender keeps serving
+        # from it); import donates like every other cache writer
+        self._jit_pexport = jax.jit(_pexport_fn)
+        self._jit_pimport = jax.jit(_pimport_fn, donate_argnums=(0, 1))
 
     # --------------------------------------------------------- capacity
     def _resolve_capacity(self, requested: int) -> int:
@@ -821,7 +867,8 @@ class PagedDecodeEngine(DecodeEngine):
             return None
         return self.prefix.lookup(ids, count=False).kind
 
-    def attach_stream(self, slot: int, stream: "DecodeStream"):
+    def attach_stream(self, slot: int, stream: "DecodeStream", *,
+                      share: bool = True):
         """The per-stream allocator/index transaction: reserve EVERY
         page the stream can ever touch (``ceil((prompt + max_new) /
         page_sz)`` — full reservation, so decode never page-faults),
@@ -829,7 +876,10 @@ class PagedDecodeEngine(DecodeEngine):
         the rest fresh.  Raises
         :class:`~pdnlp_tpu.serve.kvpage.KVPagesExhausted` (after index
         eviction) when the pool cannot cover it — the batcher leaves the
-        stream queued and retries as live streams drain."""
+        stream queued and retries as live streams drain.
+        ``share=False``: cold claim regardless of the index (the
+        KV-handoff import scatters into the reservation — writing into
+        shared prefix pages would corrupt every other holder)."""
         tokens = list(stream.prompt_ids) + list(stream.emitted)
         total = min(len(stream.prompt_ids) + stream.max_new_tokens,
                     self.max_len)
@@ -837,8 +887,8 @@ class PagedDecodeEngine(DecodeEngine):
         need = pages_needed(total, ps)
         owner = stream.rid
         n_full = len(tokens) // ps
-        hit = (self.prefix.lookup(tokens) if self.prefix_share
-               else PrefixHit("miss"))
+        hit = (self.prefix.lookup(tokens)
+               if (self.prefix_share and share) else PrefixHit("miss"))
         row = np.full((self.pages_per_stream,), self.n_pages, np.int32)
         if hit.kind == "full" and hit.first_token is not None:
             shared = [int(p) for p in hit.pages[:n_full]]
@@ -963,6 +1013,122 @@ class PagedDecodeEngine(DecodeEngine):
             self.allocator.transfer(crossed, draft_owner(st.owner),
                                     st.owner)
         st.draft_from = n_commit
+
+    # ------------------------------------------------------- KV handoff
+    # Disaggregated serving: a prefill-role engine exports one stream's
+    # pages as a dense payload and a decode-role engine imports them
+    # into its own fresh reservation.  Both programs are FIXED shape —
+    # the src/dst rows are ALWAYS the ``pages_per_stream`` table extent,
+    # sentinel-padded (jaxlint R18 polices the per-stream-count
+    # retrace spelling), so one compiled export and one compiled import
+    # serve every stream.
+    def export_pages(self, slot: int, request_ids=None):
+        """Export ``slot``'s pages as a host ``[L, pages_per_stream,
+        page_sz, N, D]`` payload pair (K, V) — raw cache bytes (int8
+        cache exports int8; both pools calibrate identical scale tables
+        from the same params, so no rescaling crosses the wire).  An
+        out-of-range ``slot`` exports the sentinel row (zero payload) —
+        the warmup path.  Compile key ``("export", pages_per_stream)``."""
+        self._flush_cow()
+        if 0 <= slot < self.slots:
+            src = np.asarray(self._table[slot], np.int32)
+        else:
+            src = np.full((self.pages_per_stream,), self.n_pages,
+                          np.int32)
+        key = ("export", int(self.pages_per_stream))
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+            span_name = "handoff"
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+            span_name = "compile"
+        with self.tracer.span(span_name, export=True, paged=True,
+                              pages=int(self.pages_per_stream),
+                              **self._telemetry_attrs(request_ids),
+                              **self.span_attrs):
+            k, v = self._jit_pexport(self._cache_k, self._cache_v, src)
+            out_k = np.asarray(jax.device_get(k))
+            out_v = np.asarray(jax.device_get(v))
+        return out_k, out_v
+
+    def import_pages(self, slot: int, payload_k, payload_v,
+                     request_ids=None) -> None:
+        """Scatter a handoff payload into ``slot``'s (cold, freshly
+        allocated) reservation.  Rows past the stream's real page count
+        carry the sentinel and are dropped; geometry is validated
+        loudly BEFORE anything writes.  An out-of-range ``slot``
+        scatters against the sentinel row (all dropped) — the warmup
+        path.  Compile key ``("import", pages_per_stream)``."""
+        cfg = self.cfg
+        want = (cfg.num_layers, self.pages_per_stream, self.page_sz,
+                cfg.num_heads, cfg.head_dim)
+        got = tuple(int(s) for s in np.shape(payload_k))
+        if got != want or tuple(int(s)
+                                for s in np.shape(payload_v)) != want:
+            raise HandoffError(
+                f"handoff payload shape {got} does not match this "
+                f"engine's page geometry {want} — pools must share one "
+                "model config and page size")
+        self._flush_cow()
+        if 0 <= slot < self.slots:
+            dst = np.asarray(self._table[slot], np.int32)
+        else:
+            dst = np.full((self.pages_per_stream,), self.n_pages,
+                          np.int32)
+        key = ("import", int(self.pages_per_stream))
+        if key in self._seen_shapes:
+            self.metrics.cache_hits.inc()
+            span_name = "handoff"
+        else:
+            self.metrics.cache_misses.inc()
+            self._seen_shapes.add(key)
+            span_name = "compile"
+        with self.tracer.span(span_name, import_=True, paged=True,
+                              pages=int(self.pages_per_stream),
+                              **self._telemetry_attrs(request_ids),
+                              **self.span_attrs):
+            self._cache_k, self._cache_v = self._jit_pimport(
+                self._cache_k, self._cache_v, jnp.asarray(payload_k),
+                jnp.asarray(payload_v), dst)
+
+    def begin_handoff(self, slot: int):
+        """Stage ``slot``'s stream for handoff: move its page refs to
+        the staging owner (:func:`~pdnlp_tpu.serve.kvpage.
+        stage_handoff` — the custody acquire the caller must discharge
+        with ``allocator.release_owner(staged)`` once the dispatch
+        settles, success or failure) and clear the slot WITHOUT
+        releasing anything — the slot row is immediately reusable while
+        the pages stay pinned under the staged owner.  Returns
+        ``(staged_owner, pages)``."""
+        st = self._slot_state[slot] if 0 <= slot < self.slots else None
+        if st is None:
+            raise ValueError(f"begin_handoff on empty slot {slot}")
+        pages = [int(p) for p in self._table[slot] if p < self.n_pages]
+        # pending COW pairs rooted in this slot's pages travel with the
+        # stream — but the payload was already exported post-flush, so
+        # by construction none are pending here; drop defensively
+        held = set(pages)
+        self._pending_cow = [(s, d) for (s, d) in self._pending_cow
+                             if d not in held and s not in held]
+        self._slot_state[slot] = None
+        self._table[slot, :] = self.n_pages
+        staged = stage_handoff(self.allocator, pages, st.owner)
+        # a full prefix hit with a partial tail page pinned the COW
+        # SOURCE under the stream owner (attach's pin list); that page
+        # is not in the table row, so the stage above left the pin
+        # behind — and the payload was exported post-flush, so its job
+        # is done.  Discharge the stream owner's leftovers here, or a
+        # handed-off full-hit stream leaks its pin forever.
+        self.allocator.release_owner(st.owner)
+        return staged, pages
+
+    def warmup_handoff(self) -> None:
+        """Pre-trace the export and import programs (sentinel rows: the
+        export reads zero-fill, the import drops every row — no live
+        page is touched).  After this a handoff never compiles."""
+        pk, pv = self.export_pages(self.slots)
+        self.import_pages(self.slots, pk, pv)
 
     def register_slot(self, slot: int, first_token: int) -> None:
         if not self.prefix_share:
@@ -1398,6 +1564,9 @@ class DecodeBatcher:
         self._free: deque = deque(range(engine.slots))
         self._freed_at: Dict[int, float] = {}
         self._waiting: deque = deque()
+        #: streams arriving by KV handoff (disaggregated pools): already
+        #: prefilled elsewhere, seated here with their imported payload
+        self._handoffs: deque = deque()
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._stop = False
@@ -1422,7 +1591,8 @@ class DecodeBatcher:
         if drain:
             with self._lock:
                 while (not self.dead and not self._stop
-                       and (self._waiting or self._live_count())):
+                       and (self._waiting or self._handoffs
+                            or self._live_count())):
                     self._wake.wait(timeout=0.05)
         with self._lock:
             self._stop = True
@@ -1432,10 +1602,12 @@ class DecodeBatcher:
         leftovers = []
         with self._lock:
             leftovers += [s for s in self._waiting]
+            leftovers += [h[0] for h in self._handoffs]
             still_live = [i for i, sl in enumerate(self._slots)
                           if sl is not None]
             leftovers += [self._slots[i].stream for i in still_live]
             self._waiting.clear()
+            self._handoffs.clear()
             self._slots = [None] * self.engine.slots
             self._free = deque(range(self.engine.slots))
         for i in still_live:
@@ -1468,7 +1640,8 @@ class DecodeBatcher:
     @property
     def load(self) -> int:
         with self._lock:
-            return self._live_count() + len(self._waiting)
+            return (self._live_count() + len(self._waiting)
+                    + len(self._handoffs))
 
     def submit_ids(self, ids: Sequence[int],
                    max_new_tokens: Optional[int] = None,
@@ -1533,6 +1706,27 @@ class DecodeBatcher:
             self._wake.notify()
         return True
 
+    def accept_handoff(self, stream: DecodeStream, pos: int,
+                       next_token: int, payload_k, payload_v) -> bool:
+        """Disaggregated pools: enqueue a stream whose prefill (and
+        first token) already happened on a prefill-role engine.  The
+        worker seats it on a cold reservation and scatters the payload
+        in (:meth:`PagedDecodeEngine.import_pages`) — no prefill runs
+        here, the next step is a plain decode.  Bypasses admission (the
+        front door admitted it); ``False`` when this batcher cannot
+        take it (dead/stopping), so the dispatcher tries the next
+        decode engine — the payload is engine-agnostic."""
+        if not self.engine.paged:
+            return False  # handoff needs page custody on the receiver
+        with self._lock:
+            if self.dead or self._stop or self._worker is None:
+                return False
+            stream.replica = self.replica
+            self._handoffs.append((stream, int(pos), int(next_token),
+                                   payload_k, payload_v))
+            self._wake.notify()
+        return True
+
     # ------------------------------------------------------------- worker
     def _run(self) -> None:
         try:
@@ -1543,12 +1737,40 @@ class DecodeBatcher:
                 # (this thread) ever clears it — a local read outside
                 # the lock keeps it out of the lock's footprint
                 dr = self.drafter
+                imports: List[tuple] = []
                 with self._lock:
                     if self._poison is not None:
                         raise self._poison
                     if self._stop:
                         return
                     self._expire_waiting_locked()
+                    # handed-off streams seat FIRST: their prefill cost
+                    # is already sunk on the prefill pool, and their
+                    # payload pins host memory until imported
+                    while self._free and self._handoffs:
+                        slot = self._free.popleft()
+                        ho = self._handoffs.popleft()
+                        stream = ho[0]
+                        try:
+                            # cold reservation (share=False): the import
+                            # scatters raw bytes into these pages
+                            self.engine.attach_stream(slot, stream,
+                                                      share=False)
+                        except KVPagesExhausted:
+                            self._free.appendleft(slot)
+                            self._handoffs.appendleft(ho)
+                            break
+                        freed = self._freed_at.pop(slot, None)
+                        if freed is not None:
+                            self.rmetrics.slot_reuse_ms.observe(
+                                (time.monotonic() - freed) * 1e3)
+                        stream.slot = slot
+                        # the seat carries the pending first token and
+                        # its write position — the next decode step
+                        # continues exactly where the prefill pool left
+                        # the stream
+                        self._slots[slot] = _Slot(stream, ho[1], ho[2])
+                        imports.append((slot,) + ho)
                     while self._free and self._waiting:
                         slot = self._free.popleft()
                         stream = self._waiting.popleft()
@@ -1605,6 +1827,8 @@ class DecodeBatcher:
                         self._wake.notify_all()  # unblock stop(drain)
                         self._wake.wait(timeout=0.05)
                         continue
+                if imports:
+                    self._import_handoffs(imports)
                 if claims:
                     self._prefill(claims)
                 with self._lock:
@@ -1634,6 +1858,48 @@ class DecodeBatcher:
             else:
                 keep.append(s)
         self._waiting = keep
+
+    def _import_handoffs(self, imports: List[tuple]) -> None:
+        """Scatter each seated handoff's payload into its fresh
+        reservation (worker-only, engine call off-lock).  No hop is
+        recorded here — the SENDER records the ``handoff`` hop when the
+        dispatch acks, and no token is emitted — the first token rode
+        the payload and was already pushed by the prefill pool."""
+        for slot, stream, _pos, _tok, pk, pv in imports:
+            self.engine.import_pages(slot, pk, pv,
+                                     request_ids=[stream.rid])
+        self._update_kv_gauge()
+
+    def retire(self) -> List[DecodeStream]:
+        """Stop this worker WITHOUT failing its streams: detach every
+        reservation and hand back live + waiting + queued-handoff
+        streams.  The pool-resplit path
+        (:meth:`DisaggDecodeRouter.set_prefill_share`) re-homes them
+        through the front door — a live stream re-prefills ``prompt +
+        emitted`` elsewhere, and greedy determinism keeps its remaining
+        tokens identical."""
+        with self._lock:
+            self._stop = True
+            self._wake.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=30)
+            self._worker = None
+        leftovers: List[DecodeStream] = []
+        with self._lock:
+            leftovers += list(self._waiting)
+            leftovers += [h[0] for h in self._handoffs]
+            still_live = [i for i, sl in enumerate(self._slots)
+                          if sl is not None]
+            leftovers += [self._slots[i].stream for i in still_live]
+            self._waiting.clear()
+            self._handoffs.clear()
+            self._slots = [None] * self.engine.slots
+            self._free = deque(range(self.engine.slots))
+        for i in still_live:
+            self.engine.detach_slot(i)
+            if self.drafter is not None:
+                self.drafter.detach_slot(i)
+        return leftovers
 
     def _prefill(self, claims: List[tuple]) -> None:
         """Prefill claimed streams and emit each stream's FIRST token.
@@ -2010,8 +2276,10 @@ class DecodeBatcher:
         with self._lock:
             self.dead = True
             orphans = [sl.stream for sl in self._slots if sl is not None]
+            orphans += [h[0] for h in self._handoffs]
             orphans += list(self._waiting)
             self._waiting.clear()
+            self._handoffs.clear()
             self._slots = [None] * self.engine.slots
             self._free = deque(range(self.engine.slots))
             self.rmetrics.ejections.inc()
@@ -2049,6 +2317,466 @@ class DecodeBatcher:
                     "engine": self.drafter.metrics.snapshot(),
                 }
         return out
+
+
+class PrefillWorker:
+    """Prefill-role half of a disaggregated pool: one worker owns one
+    PAGED engine and runs ONLY the prefill phase — bucketed cold
+    forwards, prefix full/partial hits, chunked suffixes — then moves
+    each stream's pages to a decode-role engine through the KV handoff.
+    Decode-role engines never see a prefill after warmup, so a prefill
+    burst cannot steal inter-token latency from live streams (the
+    disaggregation argument: the two phases have opposite compute
+    profiles, DistServe OSDI'24 / Splitwise ISCA'24).
+
+    Custody per handoff, in order: **export** (fixed-shape page gather
+    to a host payload) → **stage** (:meth:`PagedDecodeEngine.
+    begin_handoff` — the page refs move to the staging owner and the
+    slot frees for the next prompt) → **dispatch** (the router
+    callback: local seat or socket frame + ack) → **release** the
+    staged owner — exactly ONE discharge point whatever the outcome,
+    so both allocators' ``leak_check`` reconcile to zero after drain.
+    A failed dispatch re-queues the stream for re-prefill (the payload
+    is disposable: ``prompt + emitted`` regenerates it bitwise).
+
+    A stream whose FIRST token already finishes it (EOS, budget 1)
+    completes right here and never hands off — same ``complete``
+    semantics as the interleaved batcher's prefill-time finish."""
+
+    def __init__(self, engine: DecodeEngine, *,
+                 dispatch: Callable, max_waiting: int = 256,
+                 default_max_new: Optional[int] = None, replica: int = 0,
+                 on_death: Optional[Callable] = None,
+                 rmetrics: Optional[ReplicaMetrics] = None,
+                 dmetrics: Optional[DecodeMetrics] = None):
+        if not engine.paged:
+            raise ValueError(
+                "disaggregated prefill needs a PAGED engine "
+                "(--kv_layout paged): the handoff exports page custody")
+        self.engine = engine
+        self.tracer = engine.tracer
+        self.replica = int(replica)
+        engine.span_attrs.setdefault("replica", self.replica)
+        engine.span_attrs["pool"] = "prefill"
+        self.dispatch = dispatch
+        self.max_waiting = int(max_waiting)
+        self.default_max_new = int(
+            default_max_new
+            or getattr(engine.args, "max_new_tokens", 32))
+        self.eos_id = engine.tokenizer.sep_id
+        self.on_death = on_death
+        self.metrics = dmetrics or DecodeMetrics()
+        self.rmetrics = rmetrics or ReplicaMetrics()
+        self._slots: List[Optional[_Slot]] = [None] * engine.slots
+        self._free: deque = deque(range(engine.slots))
+        self._freed_at: Dict[int, float] = {}
+        self._waiting: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = False
+        self._poison: Optional[BaseException] = None
+        self.dead = False
+        self._worker: Optional[threading.Thread] = None
+        self._peak_live = 0
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "PrefillWorker":
+        if self._worker is None and not self.dead:
+            self._stop = False
+            self._worker = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"pdnlp-prefill-{self.replica}")
+            self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        if self._worker is None:
+            return
+        if drain:
+            with self._lock:
+                while (not self.dead and not self._stop
+                       and (self._waiting or self._live_count())):
+                    self._wake.wait(timeout=0.05)
+        with self._lock:
+            self._stop = True
+            self._wake.notify_all()
+        self._worker.join(timeout=30)
+        self._worker = None
+        leftovers = []
+        with self._lock:
+            leftovers += list(self._waiting)
+            still_live = [i for i, sl in enumerate(self._slots)
+                          if sl is not None]
+            leftovers += [self._slots[i].stream for i in still_live]
+            self._waiting.clear()
+            self._slots = [None] * self.engine.slots
+            self._free = deque(range(self.engine.slots))
+        for i in still_live:
+            self.engine.detach_slot(i)
+        for s in leftovers:
+            if s._finish(RuntimeError("prefill worker stopped")):
+                record_hop(self.tracer, s.rid, "failed",
+                           error="worker stopped")
+
+    def retire(self) -> List[DecodeStream]:
+        """Stop WITHOUT failing streams (pool re-split): detach every
+        reservation and hand back waiting + mid-prefill streams for the
+        router to re-home through the front door."""
+        with self._lock:
+            self._stop = True
+            self._wake.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=30)
+            self._worker = None
+        leftovers: List[DecodeStream] = []
+        with self._lock:
+            leftovers += list(self._waiting)
+            still_live = [i for i, sl in enumerate(self._slots)
+                          if sl is not None]
+            leftovers += [self._slots[i].stream for i in still_live]
+            self._waiting.clear()
+            self._slots = [None] * self.engine.slots
+            self._free = deque(range(self.engine.slots))
+        for i in still_live:
+            self.engine.detach_slot(i)
+        return leftovers
+
+    def __enter__(self) -> "PrefillWorker":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def kill(self, error: Optional[BaseException] = None) -> None:
+        """Chaos hook: the worker raises before its next batch."""
+        with self._lock:
+            self._poison = error or RuntimeError("injected replica kill")
+            self._wake.notify_all()
+
+    # ------------------------------------------------------------- submit
+    def _live_count(self) -> int:
+        return sum(1 for sl in self._slots if sl is not None)
+
+    @property
+    def load(self) -> int:
+        with self._lock:
+            return self._live_count() + len(self._waiting)
+
+    def submit_ids(self, ids: Sequence[int],
+                   max_new_tokens: Optional[int] = None,
+                   deadline_ms: Optional[float] = None) -> DecodeStream:
+        """Admit one generative stream (the disaggregated front door —
+        same typed refusals as :meth:`DecodeBatcher.submit_ids`)."""
+        ids = list(ids)
+        if not ids:
+            raise ValueError("empty prompt: submit at least one token id")
+        max_new = int(self.default_max_new if max_new_tokens is None
+                      else max_new_tokens)
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        stream = DecodeStream(ids, max_new, deadline)
+        tr = self.tracer
+        try:
+            self.engine.check_stream_admissible(len(ids), max_new)
+        except BaseException as e:
+            self.metrics.rejected_total.inc()
+            record_hop(tr, stream.rid, "rejected",
+                       reason=type(e).__name__)
+            raise
+        peek = self.engine.peek_prefix(ids)
+        extra = {} if peek is None else {"prefix_hit": peek}
+        with self._lock:
+            if self.dead or self._stop or self._worker is None:
+                raise RuntimeError("prefill worker is not running")
+            if len(self._waiting) >= self.max_waiting:
+                self.metrics.rejected_total.inc()
+                record_hop(tr, stream.rid, "rejected")
+                raise QueueFullError(
+                    f"prefill queue full ({len(self._waiting)}"
+                    f"/{self.max_waiting} waiting streams)")
+            stream.replica = self.replica
+            self._waiting.append(stream)
+            self.metrics.streams_total.inc()
+            self.metrics.waiting.set(len(self._waiting))
+            record_hop(tr, stream.rid, "admit", streamed=True,
+                       tokens=len(ids), max_new=max_new,
+                       replica=self.replica, pool="prefill", **extra)
+            self._wake.notify()
+        return stream
+
+    def _adopt(self, stream: DecodeStream) -> bool:
+        """Router re-home (replica death / pool re-split): enqueue an
+        orphan's continuation — ``prompt + emitted`` re-prefills here
+        and hands off again; greedy determinism keeps the remaining
+        tokens identical.  Bypasses admission."""
+        with self._lock:
+            if self.dead or self._stop or self._worker is None:
+                return False
+            stream.replica = self.replica
+            self._waiting.append(stream)
+            self.metrics.waiting.set(len(self._waiting))
+            self.rmetrics.requeued_in.inc()
+            self._wake.notify()
+        return True
+
+    # ------------------------------------------------------------- worker
+    def _run(self) -> None:
+        try:
+            while True:
+                claims: List[tuple] = []
+                with self._lock:
+                    if self._poison is not None:
+                        raise self._poison
+                    if self._stop:
+                        return
+                    self._expire_waiting_locked()
+                    # at most ONE prefill group per iteration: claiming
+                    # every free slot would serialize several prefill
+                    # forwards ahead of _dispatch_all, and an earlier
+                    # group's staged streams would sit undispatched —
+                    # their first decode-pool gap eating a later group's
+                    # prefill cost (the stall disaggregation deletes)
+                    rows = self.engine.prefill_rows
+                    while self._free and self._waiting \
+                            and len(claims) < rows:
+                        slot = self._free.popleft()
+                        stream = self._waiting.popleft()
+                        try:
+                            claim = self.engine.attach_stream(slot,
+                                                              stream)
+                        except KVPagesExhausted:
+                            # retry as in-flight handoffs release their
+                            # staged pages (same iteration, below) —
+                            # the pool floor argument the interleaved
+                            # batcher makes, on the staging ledger
+                            self._free.appendleft(slot)
+                            self._waiting.appendleft(stream)
+                            break
+                        freed = self._freed_at.pop(slot, None)
+                        if freed is not None:
+                            self.rmetrics.slot_reuse_ms.observe(
+                                (time.monotonic() - freed) * 1e3)
+                        stream.slot = slot
+                        self._slots[slot] = _Slot(stream, 0, 0)
+                        claims.append((slot, stream, claim))
+                    self.metrics.waiting.set(len(self._waiting))
+                    live = self._live_count()
+                    if live > self._peak_live:
+                        self._peak_live = live
+                        self.metrics.peak_live_streams.set(live)
+                    if not claims:
+                        if self._stop:
+                            return
+                        self._wake.notify_all()  # unblock stop(drain)
+                        self._wake.wait(timeout=0.05)
+                        continue
+                self._prefill(claims)  # dispatches per staged stream
+                with self._lock:
+                    self._wake.notify_all()
+        except BaseException as e:  # noqa: BLE001 — a dead engine must
+            self._die(e)           # never strand callers or streams
+
+    def _expire_waiting_locked(self) -> None:
+        now = time.monotonic()
+        keep: deque = deque()
+        for s in self._waiting:
+            if s.deadline is not None and now >= s.deadline:
+                self.metrics.deadline_expired_total.inc()
+                if s._finish(DeadlineExceeded(
+                        "deadline passed while waiting for a slot")):
+                    record_hop(self.tracer, s.rid, "deadline")
+            else:
+                keep.append(s)
+        self._waiting = keep
+
+    def _prefill(self, claims: List[tuple]) -> None:
+        """Prefill the claimed batch (full/partial/cold — the
+        interleaved batcher's exact three-way split), STAGE every
+        surviving stream for handoff, and dispatch each the moment its
+        export lands: a staged payload held back while a LATER stream's
+        prefill forward runs would charge that forward to the earlier
+        stream's first decode-pool gap — the exact stall the pool split
+        exists to delete."""
+        rows = self.engine.prefill_rows
+        full = [c for c in claims if c[2].kind == "full"]
+        part = [c for c in claims if c[2].kind == "partial"]
+        cold = [c for c in claims if c[2].kind == "cold"]
+        now = time.monotonic()
+        for slot, stream, claim in full:
+            ntok = len(claim.tokens)
+            record_hop(self.tracer, stream.rid, "prefill", slot=slot,
+                       tokens_in=ntok, replica=self.replica,
+                       prefix_hit="full", cached_tokens=ntok)
+            self.metrics.ttft_ms.observe((now - stream.born) * 1e3)
+            self.engine.register_slot(slot, claim.first_token)
+            h = self._emit_first(slot, stream, int(claim.first_token),
+                                 pos=ntok)
+            if h is not None:
+                self._dispatch_all([h])
+        for i in range(0, len(cold), rows):
+            chunk = cold[i:i + rows]
+            prompts = [s.prompt_ids + s.emitted for _, s, _ in chunk]
+            logits = self.engine.prefill_ids(
+                prompts, [slot for slot, _, _ in chunk],
+                request_ids=[s.rid for _, s, _ in chunk])
+            self.metrics.prefills_total.inc()
+            self.metrics.prefill_tokens_total.inc(
+                sum(len(p) for p in prompts))
+            now = time.monotonic()
+            for j, (slot, stream, claim) in enumerate(chunk):
+                record_hop(self.tracer, stream.rid, "prefill",
+                           slot=slot, tokens_in=len(prompts[j]),
+                           replica=self.replica, prefix_hit="miss")
+                self.metrics.ttft_ms.observe((now - stream.born) * 1e3)
+                tok = int(np.argmax(logits[j]))
+                self.engine.register_slot(slot, tok)
+                h = self._emit_first(slot, stream, tok,
+                                     pos=len(prompts[j]))
+                if h is not None:
+                    self._dispatch_all([h])
+        for i in range(0, len(part), rows):
+            chunk = part[i:i + rows]
+            suffixes = [c.suffix for _, _, c in chunk]
+            logits = self.engine.prefill_chunk(
+                suffixes, [slot for slot, _, _ in chunk],
+                [c.start for _, _, c in chunk],
+                request_ids=[s.rid for _, s, _ in chunk])
+            self.metrics.prefills_total.inc()
+            self.metrics.prefill_tokens_total.inc(
+                sum(len(x) for x in suffixes))
+            now = time.monotonic()
+            for j, (slot, stream, claim) in enumerate(chunk):
+                record_hop(self.tracer, stream.rid, "prefill",
+                           slot=slot, tokens_in=len(suffixes[j]),
+                           replica=self.replica, prefix_hit="partial",
+                           cached_tokens=claim.start)
+                self.metrics.ttft_ms.observe((now - stream.born) * 1e3)
+                tok = int(np.argmax(logits[j]))
+                self.engine.register_slot(slot, tok)
+                h = self._emit_first(slot, stream, tok,
+                                     pos=len(claim.tokens))
+                if h is not None:
+                    self._dispatch_all([h])
+        self._update_kv_gauge()
+
+    def _emit_first(self, slot: int, stream: DecodeStream, tok: int, *,
+                    pos: int) -> Optional[tuple]:
+        """Emit (or stop on) the prefill's first token.  A stream that
+        completes AT prefill never hands off; every other stream
+        exports its payload, stages custody, frees the slot, and
+        returns the handoff tuple for :meth:`_dispatch_all`."""
+        remaining = stream.max_new_tokens - len(stream.emitted)
+        finish = False
+        if tok == self.eos_id or remaining <= 0:
+            finish = True       # EOS is a stop decision, not an emission
+        else:
+            stream._push(tok)   # first token: ttft already observed
+            self.metrics.tokens_out_total.inc()
+            if (len(stream.emitted) >= stream.max_new_tokens
+                    or pos >= self.engine.max_len):
+                finish = True
+        if finish:
+            with self._lock:
+                self._slots[slot] = None
+                self._free.append(slot)
+                self._freed_at[slot] = time.monotonic()
+            self.engine.detach_slot(slot)
+            if stream._finish():
+                record_hop(self.tracer, stream.rid, "complete",
+                           replica=self.replica, slot=slot,
+                           tokens_out=len(stream.emitted))
+            return None
+        pk, pv = self.engine.export_pages(slot,
+                                          request_ids=[stream.rid])
+        staged, pages = self.engine.begin_handoff(slot)
+        with self._lock:
+            self._slots[slot] = None
+            self._free.append(slot)
+            self._freed_at[slot] = time.monotonic()
+        return (stream, pos, tok, staged, pages, pk, pv)
+
+    def _dispatch_all(self, handoffs: List[tuple]) -> None:
+        """Move each staged payload to a decode engine via the router's
+        dispatch callback and settle its custody: the staged owner is
+        released at exactly ONE point whatever happened (the payload is
+        self-contained once exported; a failed dispatch regenerates it
+        by re-prefill).  The ``handoff`` hop is recorded by the
+        dispatcher per placement attempt (before the seat — the
+        requeue-hop ordering precedent), so only metrics land here."""
+        alloc = self.engine.allocator
+        for stream, pos, tok, staged, pages, pk, pv in handoffs:
+            t0 = time.monotonic()
+            meta = {"rid": stream.rid, "pos": int(pos),
+                    "next_token": int(tok),
+                    "prompt_len": len(stream.prompt_ids),
+                    "n_pages": len(pages)}
+            placed = None
+            try:
+                placed = self.dispatch(stream, meta, pk, pv)
+            except BaseException:  # noqa: BLE001 — a dispatch crash is
+                placed = None      # a failed placement, not worker death
+            finally:
+                alloc.release_owner(staged)
+            if placed is None:
+                self.metrics.handoff_failures_total.inc()
+                with self._lock:
+                    if self._stop or self.dead:
+                        lost = stream
+                    else:
+                        lost = None
+                        self._waiting.appendleft(stream)  # re-prefill
+                if lost is not None and lost._finish(RuntimeError(
+                        "handoff dispatch failed")):
+                    record_hop(self.tracer, lost.rid, "failed",
+                               error="handoff dispatch failed")
+                continue
+            nbytes = int(pk.nbytes) + int(pv.nbytes)
+            self.metrics.handoffs_total.inc()
+            self.metrics.handoff_pages_total.inc(len(pages))
+            self.metrics.handoff_bytes_total.inc(nbytes)
+            self.metrics.handoff_ms.observe(
+                (time.monotonic() - t0) * 1e3)
+
+    def _update_kv_gauge(self) -> None:
+        with self._lock:
+            live_slots = self._live_count()
+        self.metrics.kv_slots_live.set(live_slots)
+        alloc = self.engine.allocator
+        self.metrics.kv_pages_live.set(alloc.used_pages)
+        self.metrics.kv_pages_free.set(alloc.free_pages)
+
+    def _die(self, error: BaseException) -> None:
+        with self._lock:
+            self.dead = True
+            orphans = [sl.stream for sl in self._slots
+                       if sl is not None]
+            orphans += list(self._waiting)
+            self._waiting.clear()
+            self._slots = [None] * self.engine.slots
+            self._free = deque(range(self.engine.slots))
+            self.rmetrics.ejections.inc()
+            self._wake.notify_all()
+        if self.on_death is not None:
+            self.on_death(self.replica, orphans, error)
+        else:
+            for s in orphans:
+                if s._finish(error):
+                    record_hop(self.tracer, s.rid, "failed",
+                               error=type(error).__name__)
+
+    # ------------------------------------------------------------ surface
+    def warmup(self) -> None:
+        self.engine.warmup_decode()
+        self.engine.warmup_handoff()
+
+    def snapshot(self) -> Dict:
+        return {
+            "pool": "prefill",
+            "decode": self.metrics.snapshot(),
+            "replica": self.rmetrics.snapshot(),
+            "kv": self.engine.kv_snapshot(),
+            "engine": self.engine.metrics.snapshot(),
+        }
 
 
 class DecodeRouter:
@@ -2262,3 +2990,454 @@ class DecodeRouter:
                 "knobs": self.knob_values(),
                 "speculation": spec_agg,
                 "replicas": reps}
+
+
+class DisaggDecodeRouter:
+    """Disaggregated prefill/decode engine pools behind one front door
+    (ROADMAP item 4: DistServe OSDI'24 / Splitwise ISCA'24).
+
+    All engines are PAGED and share one geometry; each is wrapped in a
+    role unit — :class:`PrefillWorker` or :class:`DecodeBatcher` — with
+    the engine index as its replica id.  Submissions land least-loaded
+    on the prefill pool; a finished prefill hands its pages off
+    least-loaded onto the decode pool.  ``transport="local"`` seats the
+    exported payload in-process; ``"socket"`` pushes every payload
+    through :mod:`pdnlp_tpu.serve.handoff`'s length-prefixed loopback
+    framing (one :class:`HandoffServer` per decode unit, one connected
+    :class:`HandoffChannel` per target) — the process-split rehearsal.
+
+    The pool split is LIVE: :meth:`set_prefill_share` (the controller's
+    ``prefill_share`` knob) retires units on the shrinking side,
+    rebuilds them in the other role, and re-homes their streams through
+    the front door (re-prefill; greedy decode is deterministic, so the
+    continuation is bitwise unchanged).  Engines keep their jit caches
+    across re-roles and :meth:`warmup` pre-traces EVERY program on
+    EVERY engine, so neither a re-role nor a handoff ever compiles
+    post-warmup — the bench's zero-retrace gate covers both pools."""
+
+    def __init__(self, engines: Sequence[DecodeEngine], *,
+                 prefill_engines: int = 1, max_waiting: int = 256,
+                 default_max_new: Optional[int] = None,
+                 transport: str = "local"):
+        if len(engines) < 2:
+            raise ValueError(
+                "disaggregated serving needs >= 2 engines (at least "
+                "one per role); use DecodeRouter for a single engine")
+        for e in engines:
+            if not e.paged:
+                raise ValueError(
+                    "disaggregated serving needs PAGED engines "
+                    "(--kv_layout paged): the handoff moves page "
+                    "custody between allocators")
+        if transport not in ("local", "socket"):
+            raise ValueError(f"unknown handoff transport {transport!r}")
+        self.engines = list(engines)
+        self.transport = transport
+        self.tracer = engines[0].tracer
+        self.max_waiting = int(max_waiting)
+        self.default_max_new = default_max_new
+        self._lock = threading.Lock()
+        self._started = False
+        n = len(self.engines)
+        k = max(1, min(n - 1, int(prefill_engines)))
+        self._servers: Dict[int, HandoffServer] = {}
+        self._channels: Dict[int, HandoffChannel] = {}
+        #: rid -> DecodeStream for payloads currently on the wire
+        #: (socket transport; the frame carries metadata, the live
+        #: stream object is joined back by rid on receive)
+        self._inflight: Dict[str, DecodeStream] = {}
+        self._units: List[object] = [
+            self._build_unit(i, "prefill" if i < k else "decode")
+            for i in range(n)]
+
+    # ------------------------------------------------------ unit plumbing
+    def _build_unit(self, i: int, role: str):
+        """One engine, one role: wrap engine ``i`` as a PrefillWorker or
+        DecodeBatcher (socket mode also gives each decode unit its
+        receive server + the router's send channel to it)."""
+        e = self.engines[i]
+        e.span_attrs["pool"] = role  # re-assign: roles flip on re-split
+        if role == "prefill":
+            return PrefillWorker(
+                e, dispatch=self._dispatch, max_waiting=self.max_waiting,
+                default_max_new=self.default_max_new, replica=i,
+                on_death=self._on_death)
+        unit = DecodeBatcher(
+            e, max_waiting=self.max_waiting,
+            default_max_new=self.default_max_new, replica=i,
+            on_death=self._on_death)
+        if self.transport == "socket":
+            srv = HandoffServer(self._make_receiver(i)).start()
+            with self._lock:
+                self._servers[i] = srv
+                self._channels[i] = HandoffChannel(srv.address)
+        return unit
+
+    def _teardown_transport(self, i: int) -> None:
+        with self._lock:
+            ch = self._channels.pop(i, None)
+            srv = self._servers.pop(i, None)
+        if ch is not None:
+            ch.close()
+        if srv is not None:
+            srv.stop()
+
+    def _make_receiver(self, i: int) -> Callable:
+        """Socket mode: decode unit ``i``'s frame callback.  The wire
+        payload carries the stream METADATA; the live DecodeStream
+        object (the caller's handle) is joined back by rid from the
+        sender's in-flight table.  A raise here is the NACK the sender's
+        custody logic keys on."""
+        def on_payload(meta: Dict, k: np.ndarray, v: np.ndarray) -> None:
+            with self._lock:
+                stream = self._inflight.pop(meta["rid"], None)
+            if stream is None:
+                raise HandoffError(
+                    f"no in-flight stream for rid {meta['rid']!r}")
+            unit = self._units[i]
+            if not isinstance(unit, DecodeBatcher) \
+                    or not unit.accept_handoff(
+                        stream, meta["pos"], meta["next_token"], k, v):
+                raise HandoffError(
+                    f"decode unit {i} refused the handoff")
+        return on_payload
+
+    def _prefill_units(self) -> List["PrefillWorker"]:
+        with self._lock:
+            return [u for u in self._units
+                    if isinstance(u, PrefillWorker) and not u.dead
+                    and u._worker is not None]
+
+    def _decode_units(self) -> List[DecodeBatcher]:
+        with self._lock:
+            return [u for u in self._units
+                    if isinstance(u, DecodeBatcher) and not u.dead
+                    and u._worker is not None]
+
+    # ----------------------------------------------------------- dispatch
+    def _dispatch(self, stream: DecodeStream, meta: Dict,
+                  payload_k, payload_v) -> Optional[tuple]:
+        """PrefillWorker callback: place one exported payload on the
+        least-loaded live decode unit; returns ``(to_replica,
+        transport)`` or ``None`` when no decode unit took it.  The
+        ``handoff`` hop is recorded per attempt BEFORE the seat (the
+        requeue-hop ordering precedent: once seated, the decode worker
+        may finish the stream immediately, and a handoff hop landing
+        after the terminal would fail chain validation)."""
+        from_replica = stream.replica
+        nbytes = int(payload_k.nbytes) + int(payload_v.nbytes)
+        for target in sorted(self._decode_units(), key=lambda b: b.load):
+            record_hop(self.tracer, stream.rid, "handoff",
+                       from_replica=from_replica,
+                       to_replica=target.replica,
+                       pages=meta["n_pages"], bytes=nbytes,
+                       transport=self.transport)
+            if self.transport == "local":
+                if target.accept_handoff(stream, meta["pos"],
+                                         meta["next_token"],
+                                         payload_k, payload_v):
+                    return (target.replica, "local")
+                continue
+            with self._lock:
+                ch = self._channels.get(target.replica)
+                self._inflight[stream.rid] = stream
+            if ch is None:
+                with self._lock:
+                    self._inflight.pop(stream.rid, None)
+                continue
+            try:
+                ch.send(meta, payload_k, payload_v)
+                return (target.replica, "socket")
+            except HandoffError:
+                with self._lock:
+                    self._inflight.pop(stream.rid, None)
+                continue
+        return None
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "DisaggDecodeRouter":
+        with self._lock:
+            self._started = True
+            units = list(self._units)
+        for u in units:
+            u.start()
+        return self
+
+    def warmup(self) -> None:
+        """Pre-trace EVERY program on EVERY engine — prefill buckets,
+        chunk, decode, COW, export AND import — so a handoff or a pool
+        re-split never compiles (both roles run from warm caches)."""
+        for e in self.engines:
+            e.warmup_decode()
+            e.warmup_handoff()
+
+    def wait_ready(self) -> bool:
+        return bool(self._prefill_units()) and bool(self._decode_units())
+
+    def stop(self, drain: bool = True) -> None:
+        with self._lock:
+            units = list(self._units)
+        # prefill first: its drain flushes queued streams THROUGH the
+        # handoff, decode's drain then finishes them
+        for u in units:
+            if isinstance(u, PrefillWorker):
+                u.stop(drain=drain)
+        for u in units:
+            if isinstance(u, DecodeBatcher):
+                u.stop(drain=drain)
+        with self._lock:
+            channels = list(self._channels.values())
+            servers = list(self._servers.values())
+            self._channels.clear()
+            self._servers.clear()
+        for ch in channels:
+            ch.close()
+        for srv in servers:
+            srv.stop()
+
+    def engine(self, i: int = 0) -> DecodeEngine:
+        return self.engines[i]
+
+    def alive(self) -> List[object]:
+        with self._lock:
+            return [u for u in self._units
+                    if not u.dead and u._worker is not None]
+
+    def kill(self, replica: int,
+             error: Optional[BaseException] = None) -> None:
+        self._units[replica].kill(error)
+
+    # -------------------------------------------------------- front door
+    def submit_ids(self, ids: Sequence[int],
+                   max_new_tokens: Optional[int] = None,
+                   deadline_ms: Optional[float] = None) -> DecodeStream:
+        workers = self._prefill_units()
+        if not workers:
+            raise RuntimeError("no live prefill replica")
+        target = min(workers, key=lambda w: w.load)
+        return target.submit_ids(ids, max_new_tokens=max_new_tokens,
+                                 deadline_ms=deadline_ms)
+
+    def _reintake(self, streams: List[DecodeStream], from_replica: int,
+                  error: Optional[BaseException] = None) -> None:
+        """Re-home orphans (replica death, pool re-split) through the
+        prefill pool: ``prompt + emitted`` re-prefills and hands off
+        again — the transfer ledger's recovery story.  The requeue hop
+        lands BEFORE the adopt (ordering precedent, see
+        :meth:`DecodeRouter._on_death`)."""
+        err = error or RuntimeError("no live prefill replica")
+        for stream in streams:
+            homed = False
+            for target in sorted(self._prefill_units(),
+                                 key=lambda w: w.load):
+                record_hop(self.tracer, stream.rid, "requeue",
+                           from_replica=from_replica,
+                           to_replica=target.replica, streamed=True,
+                           tokens_emitted=len(stream.emitted))
+                if target._adopt(stream):
+                    unit = self._units[from_replica]
+                    if unit is not None:
+                        unit.rmetrics.requeued_out.inc()
+                    homed = True
+                    break
+            if not homed:
+                if stream._finish(err):
+                    record_hop(self.tracer, stream.rid, "failed",
+                               error=type(err).__name__)
+
+    def _on_death(self, replica: int, orphans: List[DecodeStream],
+                  error: BaseException) -> None:
+        self._reintake(orphans, replica, error)
+
+    # ------------------------------------------------- controller surface
+    def set_prefill_share(self, value: float) -> float:
+        """Actuate the pool split: ``value`` is the FRACTION of engines
+        in the prefill role, quantized to whole engines with a floor of
+        one per role.  Units on the shrinking side retire (streams
+        re-enter the front door), rebuild in the other role, and restart
+        from the engine's warm jit caches.  Returns the applied
+        (quantized) share — the exact value :meth:`knob_values` will
+        report, so the controller's eval-window staleness check holds."""
+        n = len(self.engines)
+        step = round(1.0 / n, 6)
+        k_new = max(1, min(n - 1, int(round(float(value) * n))))
+        with self._lock:
+            pre_idx = [i for i, u in enumerate(self._units)
+                       if isinstance(u, PrefillWorker)]
+            dec_idx = [i for i, u in enumerate(self._units)
+                       if isinstance(u, DecodeBatcher)]
+            started = self._started
+        k_old = len(pre_idx)
+        if k_new == k_old:
+            return round(k_new * step, 6)
+        if k_new > k_old:
+            flip = sorted(dec_idx,
+                          key=lambda i: self._units[i].load)[:k_new - k_old]
+            role = "prefill"
+        else:
+            flip = sorted(pre_idx,
+                          key=lambda i: self._units[i].load)[:k_old - k_new]
+            role = "decode"
+        leftovers: List[DecodeStream] = []
+        for i in flip:
+            old = self._units[i]
+            leftovers += old.retire()
+            if isinstance(old, DecodeBatcher):
+                self._teardown_transport(i)
+            new = self._build_unit(i, role)
+            with self._lock:
+                self._units[i] = new
+            if started:
+                new.start()
+        for stream in leftovers:
+            self._reintake([stream], stream.replica
+                           if stream.replica is not None else flip[0])
+        return round(k_new * step, 6)
+
+    def knob_values(self) -> Dict:
+        """Controller sense surface: the live split plus its quantum.
+        The share is reported as ``k * step`` (both rounded the same
+        way the split law composes them), so an actuated target and the
+        re-sensed value compare EQUAL — the eval window's staleness
+        check must not see ghosts."""
+        n = len(self.engines)
+        step = round(1.0 / n, 6)
+        with self._lock:
+            k = sum(1 for u in self._units
+                    if isinstance(u, PrefillWorker))
+        return {"prefill_share": round(k * step, 6),
+                "prefill_share_step": step}
+
+    def apply_knob(self, knob: str, value) -> None:
+        if knob != "prefill_share":
+            raise ValueError(f"unknown disagg knob {knob!r}")
+        self.set_prefill_share(float(value))
+
+    def health_summary(self) -> Dict:
+        """Compact ``/healthz`` block: liveness + the split + per-pool
+        pressure at a glance (``by_pool`` flattens with a ``pool``
+        label on ``/metrics``)."""
+        with self._lock:
+            units = list(self._units)
+        pre = [u for u in units if isinstance(u, PrefillWorker)]
+        dec = [u for u in units if isinstance(u, DecodeBatcher)]
+        return {
+            "alive": len(self.alive()),
+            "replicas": len(units),
+            "transport": self.transport,
+            "prefill_share": self.knob_values()["prefill_share"],
+            "handoffs": sum(int(u.metrics.handoffs_total.value)
+                            for u in pre),
+            "handoff_failures": sum(
+                int(u.metrics.handoff_failures_total.value)
+                for u in pre),
+            "by_pool": {
+                "prefill": {
+                    "engines": len(pre),
+                    "alive": sum(1 for u in pre if not u.dead
+                                 and u._worker is not None),
+                    "backlog": sum(len(u._waiting) for u in pre),
+                },
+                "decode": {
+                    "engines": len(dec),
+                    "alive": sum(1 for u in dec if not u.dead
+                                 and u._worker is not None),
+                    "backlog": sum(len(u._handoffs) for u in dec),
+                },
+            },
+        }
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            units = list(self._units)
+        return {
+            "replicas": {str(u.replica): u.snapshot() for u in units},
+            "alive": len(self.alive()),
+            "transport": self.transport,
+        }
+
+    def control_snapshot(self) -> Dict:
+        """The controller's sense surface: fleet paging view (same
+        ``pages`` aggregate as :meth:`DecodeRouter.control_snapshot`)
+        PLUS the two latency signals the pool-split law trades off —
+        ``ttft_p99_ms`` vs ``inter_token_p99_ms``, pooled across every
+        unit's own histogram windows (``merged_percentiles``: one
+        fleet-level p99, not an average of per-unit p99s) — and a
+        ``by_pool`` pressure block."""
+        with self._lock:
+            units = list(self._units)
+        pre = [u for u in units if isinstance(u, PrefillWorker)]
+        dec = [u for u in units if isinstance(u, DecodeBatcher)]
+        ttft = merged_percentiles(
+            [u.metrics.ttft_ms for u in units], (50, 99))
+        itok = merged_percentiles(
+            [u.metrics.intertoken_ms for u in units], (50, 99))
+        agg = {"pages_total": 0, "pages_live": 0, "free_depth": 0,
+               "cow_copies": 0, "evictions": 0, "alloc_failures": 0,
+               "hits_full": 0, "hits_partial": 0, "misses": 0,
+               "index_entries": 0}
+        reps: Dict[str, Dict] = {}
+        for u in units:
+            kv = u.engine.kv_snapshot()
+            rep: Dict = {"alive": int(not u.dead), "load": u.load,
+                         "pool": ("prefill"
+                                  if isinstance(u, PrefillWorker)
+                                  else "decode"),
+                         "peak_live_streams": u._peak_live}
+            pages = kv.get("pages")
+            prefix = kv.get("prefix")
+            if pages:
+                rep["pages"] = pages
+                agg["pages_total"] += pages["total_pages"]
+                agg["pages_live"] += pages["pages_live"]
+                agg["free_depth"] += pages["free_depth"]
+                agg["cow_copies"] += pages["cow_copies"]
+                agg["evictions"] += pages["evictions"]
+                agg["alloc_failures"] += pages["alloc_failures"]
+            if prefix:
+                rep["prefix"] = prefix
+                agg["hits_full"] += prefix["hits_full"]
+                agg["hits_partial"] += prefix["hits_partial"]
+                agg["misses"] += prefix["misses"]
+                agg["index_entries"] += prefix["entries"]
+            reps[str(u.replica)] = rep
+        looked = agg["hits_full"] + agg["hits_partial"] + agg["misses"]
+        agg["prefix_hit_rate"] = (
+            (agg["hits_full"] + agg["hits_partial"]) / looked
+            if looked else 0.0)
+        agg["page_occupancy"] = (agg["pages_live"] / agg["pages_total"]
+                                 if agg["pages_total"] else 0.0)
+        return {
+            "alive": len(self.alive()),
+            "pages": agg,
+            "knobs": self.knob_values(),
+            "latency": {
+                "ttft_p50_ms": ttft[0], "ttft_p99_ms": ttft[1],
+                "inter_token_p50_ms": itok[0],
+                "inter_token_p99_ms": itok[1],
+            },
+            "by_pool": {
+                "prefill": {
+                    "engines": len(pre),
+                    "alive": sum(1 for u in pre if not u.dead
+                                 and u._worker is not None),
+                    "backlog": sum(len(u._waiting) for u in pre),
+                    "handoffs": sum(
+                        int(u.metrics.handoffs_total.value)
+                        for u in pre),
+                    "handoff_failures": sum(
+                        int(u.metrics.handoff_failures_total.value)
+                        for u in pre),
+                },
+                "decode": {
+                    "engines": len(dec),
+                    "alive": sum(1 for u in dec if not u.dead
+                                 and u._worker is not None),
+                    "backlog": sum(len(u._handoffs) for u in dec),
+                    "live": sum(
+                        int(u.metrics.kv_slots_live.value)
+                        for u in dec),
+                },
+            },
+            "replicas": reps,
+        }

@@ -72,6 +72,38 @@ def draft_owner(owner: str) -> str:
     return owner + DRAFT_SUFFIX
 
 
+#: suffix marking pages staged for a cross-engine KV handoff
+#: (disaggregated prefill -> decode).  The prefill engine moves a
+#: finished stream's pages from the stream owner to
+#: ``handoff_owner(owner)`` the moment the payload is exported; from
+#: that point the stream no longer "lives" on the prefill engine (its
+#: slot and table row are reusable) but the pages stay pinned until the
+#: decode side acknowledges the import — then the staged owner is
+#: released in one sweep.  A dispatch failure releases the SAME staged
+#: owner, so there is exactly one discharge point per outcome and
+#: ``leak_check`` reconciles to zero on both allocators.
+HANDOFF_SUFFIX = "#handoff"
+
+
+def handoff_owner(owner: str) -> str:
+    """Owner key for a stream's staged (in-flight handoff) page refs."""
+    return owner + HANDOFF_SUFFIX
+
+
+def stage_handoff(allocator: "PageAllocator", pages: Sequence[int],
+                  from_owner: str) -> str:
+    """Re-ledger ``from_owner``'s pages onto its handoff staging owner
+    and return that owner key.  This is the custody acquire of a KV
+    handoff: the caller now OWES a ``release_owner`` (success ack or
+    dispatch failure) on the returned key — leaklint L1 tracks the
+    obligation (``kv-pages`` spec, ``stage_handoff`` in ``funcs``), so a
+    path that exports a payload and forgets the staged pages is a lint
+    finding, not a slow leak."""
+    staged = handoff_owner(from_owner)
+    allocator.transfer(pages, from_owner, staged)
+    return staged
+
+
 class KVPagesExhausted(KVBudgetExceeded):
     """A page allocation could not be satisfied even after index
     eviction — the paged engine's loud refusal, in page units."""

@@ -196,6 +196,17 @@ class DecodeMetrics:
       counts ride the ``verify`` hops);
     - ``drafter_deaths_total`` — drafter engines lost mid-storm (each
       one degraded its pair to primary-only decode, decision-recorded).
+
+    Disaggregated pools (prefill-role vs decode-role engines) add the
+    handoff accounting — sender-side, counted when the receiver ACKED:
+
+    - ``handoffs_total`` / ``handoff_pages_total`` /
+      ``handoff_bytes_total`` — placed handoffs and the page/byte
+      volume they moved between allocators;
+    - ``handoff_failures_total`` — dispatches no decode engine took
+      (each one re-prefilled at the sender: recovery, not loss);
+    - ``handoff_ms`` — export→ack latency per handoff (the
+      disaggregation tax ``bench.py --decode`` phase F budgets).
     """
 
     def __init__(self) -> None:
@@ -211,8 +222,13 @@ class DecodeMetrics:
         self.verify_calls_total = Counter()
         self.spec_rounds_total = Counter()
         self.drafter_deaths_total = Counter()
+        self.handoffs_total = Counter()
+        self.handoff_pages_total = Counter()
+        self.handoff_bytes_total = Counter()
+        self.handoff_failures_total = Counter()
         self.ttft_ms = Histogram()
         self.intertoken_ms = Histogram()
+        self.handoff_ms = Histogram()
         self.waiting = Gauge()
         self.accept_rate = Gauge()
         self.kv_bytes_live = Gauge()
@@ -235,9 +251,14 @@ class DecodeMetrics:
             "verify_calls_total": self.verify_calls_total.value,
             "spec_rounds_total": self.spec_rounds_total.value,
             "drafter_deaths_total": self.drafter_deaths_total.value,
+            "handoffs_total": self.handoffs_total.value,
+            "handoff_pages_total": self.handoff_pages_total.value,
+            "handoff_bytes_total": self.handoff_bytes_total.value,
+            "handoff_failures_total": self.handoff_failures_total.value,
             "accept_rate": self.accept_rate.value,
             "ttft_ms": self.ttft_ms.snapshot(),
             "intertoken_ms": self.intertoken_ms.snapshot(),
+            "handoff_ms": self.handoff_ms.snapshot(),
             "waiting": self.waiting.value,
             "kv_bytes_live": self.kv_bytes_live.value,
             "kv_slots_live": self.kv_slots_live.value,

@@ -123,6 +123,25 @@ class Histogram:
         }
 
 
+def merged_percentiles(hists: Sequence[Histogram],
+                       ps: Sequence[float]) -> List[Optional[float]]:
+    """Percentiles over the POOLED recent windows of several histograms —
+    one fleet-level p99, not an average of per-instrument p99s (averaging
+    percentiles understates the tail whenever load is uneven across
+    units, which is exactly when the pool-split controller must act).
+    Returns ``None`` per requested percentile when no histogram has
+    observations yet."""
+    windows = []
+    for h in hists:
+        with h._lock:
+            if h._recent:
+                windows.append(np.asarray(h._recent))
+    if not windows:
+        return [None] * len(ps)
+    pooled = np.concatenate(windows)
+    return [float(v) for v in np.percentile(pooled, list(ps))]
+
+
 class TransportStats:
     """Host->device transport telemetry for one input pipeline.
 

@@ -298,7 +298,8 @@ def build_decode_pool(args: Args, replicas: int, *,
                       checkpoint: Optional[str] = None,
                       use_mesh: bool = True, buckets=DEFAULT_BUCKETS,
                       max_waiting: int = 256,
-                      speculate: Optional[str] = None, draft_k: int = 4):
+                      speculate: Optional[str] = None, draft_k: int = 4,
+                      disagg: str = "off", prefill_engines: int = 1):
     """Generative serving pool: ``replicas`` :class:`DecodeEngine`\\ s —
     device-group meshes when the host has them, plain jit otherwise —
     behind a :class:`DecodeRouter` (1 replica included: the router is the
@@ -315,11 +316,21 @@ def build_decode_pool(args: Args, replicas: int, *,
     :class:`PagedDecodeEngine` with ``prefix_share=False`` (its cold
     re-prefill rewrites pages in place — shared prefix pages would be
     corrupted) and mirrors the primary's slots/max_len geometry so slot
-    indices line up pair-wise."""
+    indices line up pair-wise.
+
+    ``disagg`` (``--disagg local|socket``) splits the fleet into
+    prefill-role and decode-role engine pools behind a
+    :class:`~pdnlp_tpu.serve.decode.DisaggDecodeRouter`: prefill engines
+    run only prompt forwards and hand each stream's KV pages to a decode
+    engine (``local`` = in-process payload, ``socket`` = the
+    length-prefixed loopback RPC framing); ``prefill_engines`` sets the
+    initial split (the controller's ``prefill_share`` knob re-balances
+    it live)."""
     import jax
 
     from pdnlp_tpu.data.tokenizer import WordPieceTokenizer, get_or_build_vocab
     from pdnlp_tpu.serve import DecodeEngine, DecodeRouter, PagedDecodeEngine
+    from pdnlp_tpu.serve.decode import DisaggDecodeRouter
 
     groups: list = [None] * replicas
     if use_mesh:
@@ -334,6 +345,17 @@ def build_decode_pool(args: Args, replicas: int, *,
                       for i in range(replicas)]
     tok = WordPieceTokenizer(get_or_build_vocab(args))
     paged = getattr(args, "kv_layout", "paged") != "slots"
+    if disagg != "off":
+        if not paged:
+            sys.exit("serve_tpu: --disagg needs --kv_layout paged (the "
+                     "handoff moves page custody between engines)")
+        if speculate:
+            sys.exit("serve_tpu: --disagg and --speculate are exclusive "
+                     "for now — decode-role engines run without "
+                     "drafters")
+        if replicas < 2:
+            sys.exit("serve_tpu: --disagg needs --replicas >= 2 (at "
+                     "least one engine per role)")
     cls = PagedDecodeEngine if paged else DecodeEngine
     engines = [cls(args, tokenizer=tok, mesh=groups[i],
                    buckets=buckets) for i in range(replicas)]
@@ -381,6 +403,15 @@ def build_decode_pool(args: Args, replicas: int, *,
                     f"({dspec.checkpoint or '<init weights>'} "
                     f"[{dspec.dtype}]) drafts k={draft_k} per round",
                     file=sys.stderr)
+    if disagg != "off":
+        transport = "socket" if disagg == "socket" else "local"
+        rank0_print(f"disaggregated pools: {prefill_engines} prefill / "
+                    f"{replicas - prefill_engines} decode engine(s), "
+                    f"{transport} handoff", file=sys.stderr)
+        return DisaggDecodeRouter(
+            engines, prefill_engines=prefill_engines,
+            max_waiting=max_waiting,
+            default_max_new=args.max_new_tokens, transport=transport)
     return DecodeRouter(engines, max_waiting=max_waiting,
                         default_max_new=args.max_new_tokens,
                         drafters=drafters, draft_k=draft_k)
@@ -407,7 +438,9 @@ def serve_decode(args: Args, argv_flags: dict) -> None:
         use_mesh=argv_flags["use_mesh"], buckets=argv_flags["buckets"],
         max_waiting=argv_flags["max_queue"],
         speculate=argv_flags.get("speculate"),
-        draft_k=argv_flags.get("draft_k", 4))
+        draft_k=argv_flags.get("draft_k", 4),
+        disagg=argv_flags.get("disagg", "off"),
+        prefill_engines=argv_flags.get("prefill_engines", 1))
     engine = pool.engine(0)
     pool.start()
     pool.warmup()
@@ -455,7 +488,9 @@ def serve_decode(args: Args, argv_flags: dict) -> None:
     # enough in-flight streams to keep every slot claimable, capped at
     # the waiting-queue bound so pipelining can never walk submissions
     # into the reject tier
-    window = min(2 * sum(b.engine.slots for b in pool.batchers),
+    pool_engines = (pool.engines if hasattr(pool, "engines")
+                    else [b.engine for b in pool.batchers])
+    window = min(2 * sum(e.slots for e in pool_engines),
                  argv_flags["max_queue"])
     inflight: deque = deque()
 
@@ -553,6 +588,9 @@ def main(argv=None) -> None:
     argv, rollout_mode = pop_cli_flag(argv, "--rollout", "auto")
     argv, speculate = pop_cli_flag(argv, "--speculate")
     argv, draft_k = pop_cli_flag(argv, "--draft_k", 4, int)
+    argv, disagg = pop_cli_flag(argv, "--disagg", "off")
+    argv, prefill_engines = pop_cli_flag(argv, "--prefill_engines", 1, int)
+    argv, decode_engines = pop_cli_flag(argv, "--decode_engines", None, int)
     argv, in_path = pop_cli_flag(argv, "--input")
     argv, out_path = pop_cli_flag(argv, "--output")
     argv, metrics_path = pop_cli_flag(argv, "--metrics_path")
@@ -572,16 +610,32 @@ def main(argv=None) -> None:
             sys.exit("serve_tpu: --decode is the generative online path — "
                      "drop --fleet/--input/--serve_pack")
         _install_signal_handlers()
+        if disagg == "on":
+            disagg = "local"  # "on" is shorthand for same-host handoff
+        if disagg not in ("off", "local", "socket"):
+            sys.exit("serve_tpu: --disagg takes off|local|socket")
+        if disagg != "off" and decode_engines is not None:
+            # explicit pool sizes: the fleet is their sum; --replicas (if
+            # also given) must agree rather than silently losing engines
+            total = prefill_engines + decode_engines
+            if replicas not in (1, total):
+                sys.exit("serve_tpu: --replicas disagrees with "
+                         "--prefill_engines + --decode_engines")
+            replicas = total
         return serve_decode(args, {
             "replicas": replicas, "checkpoint": checkpoint,
             "use_mesh": not no_mesh, "buckets": buckets,
             "max_queue": max_queue, "metrics_path": metrics_path,
             "deadline_ms": deadline, "speculate": speculate,
             "draft_k": draft_k, "controller": controller_mode,
+            "disagg": disagg, "prefill_engines": prefill_engines,
         })
     if speculate:
         sys.exit("serve_tpu: --speculate is the generative path — "
                  "speculative decoding needs --decode")
+    if disagg != "off" or decode_engines is not None:
+        sys.exit("serve_tpu: --disagg splits the generative decode fleet — "
+                 "it needs --decode")
     # chunked prefill (--serve_long_widths "512,1024"): single-replica
     # frontend only — the router's queues stay short-width; a long request
     # hitting a router deployment truncates at the largest bucket as before

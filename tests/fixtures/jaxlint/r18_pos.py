@@ -1,0 +1,25 @@
+"""R18 positives: handoff export/import shaped by the live page count."""
+import jax  # noqa: F401
+import numpy as np
+
+
+def storm_export(export_fn, cache_k, cache_v, table, live, n_pages):
+    payloads = []
+    for slot in live:
+        pages = [p for p in table[slot] if p < n_pages]
+        payloads.append(export_fn(cache_k, cache_v, np.asarray(pages)))
+    return payloads
+
+
+def sliced_import(import_fn, cache_k, cache_v, pk, pv, dst, n_live):
+    return import_fn(cache_k, cache_v, pk, pv, dst[:n_live])
+
+
+def inline_comp_export(export_fn, cache_k, cache_v, row, n_pages):
+    return export_fn(cache_k, cache_v,
+                     np.asarray([p for p in row if p < n_pages]))
+
+
+def filtered_import(import_fn, cache_k, cache_v, pk, pv, row, n_pages):
+    dst = list(filter(lambda p: p < n_pages, row))
+    import_fn(cache_k, cache_v, pk, pv, dst)

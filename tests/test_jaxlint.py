@@ -440,6 +440,29 @@ def test_r17_hint_names_the_fix():
     assert "data argument" in f.hint
 
 
+def test_r18_handoff_retrace_positive():
+    # export index built from the filtered live-page list (10), import
+    # target sliced to the runtime count (15), inline comprehension
+    # (19), filter()-built destination (25)
+    assert all_hits("r18_pos.py") == [("R18", 10), ("R18", 15),
+                                      ("R18", 19), ("R18", 25)]
+
+
+def test_r18_handoff_retrace_negative():
+    # the engine spelling (full table row), sentinel np.full padding,
+    # literal-bound slices, the runtime count as scalar data, and a
+    # varlen array passed to a NON-handoff call all stay clean
+    assert hits("r18_neg.py", "R18") == []
+
+
+def test_r18_hint_names_the_fix():
+    path = os.path.join(FIXTURES, "r18_pos.py")
+    f = [x for x in analyze_paths([path], root=REPO)
+         if x.rule_id == "R18"][0]
+    assert "pages_per_stream" in f.hint
+    assert "export_pages" in f.hint
+
+
 # ------------------------------------------------- concurrency suite (T1-T3)
 
 def test_t1_unguarded_attr_positive():
@@ -722,12 +745,12 @@ def test_findings_carry_exact_location_and_hint():
 
 def test_rule_registry_complete():
     # the registry sorts by id STRING (the lifecycle suite's L1-L4
-    # before the R's; R10..R17 between R1 and R2; the concurrency
+    # before the R's; R10..R18 between R1 and R2; the concurrency
     # suite's T1-T3 after the R's)
     assert list(all_rules()) == ["L1", "L2", "L3", "L4",
                                  "R1", "R10", "R11", "R12", "R13", "R14",
-                                 "R15", "R16", "R17", "R2", "R3", "R4",
-                                 "R5", "R6", "R7", "R8", "R9",
+                                 "R15", "R16", "R17", "R18", "R2", "R3",
+                                 "R4", "R5", "R6", "R7", "R8", "R9",
                                  "T1", "T2", "T3"]
     suites = {rid: r.suite for rid, r in all_rules().items()}
     assert all(s == "concurrency" for rid, s in suites.items()

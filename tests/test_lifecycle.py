@@ -154,6 +154,35 @@ def test_l1_messages_cite_kind_and_escape_site():
     assert "standby" in f.message and "exception edge" in f.message
 
 
+def test_l1_handoff_custody_positive():
+    # staged owner leaked on a dispatch raise (15) and a bare return
+    # (20); handoff channel (28) and raw socket (34) never closed
+    assert all_hits("l1_handoff_pos.py") == [
+        ("L1", 15), ("L1", 20), ("L1", 28), ("L1", 34)]
+    f = finding("l1_handoff_pos.py", "L1", 15)
+    assert "kv-pages" in f.message and "stage_handoff" in f.message
+    f = finding("l1_handoff_pos.py", "L1", 28)
+    assert "handoff-conn" in f.message
+
+
+def test_l1_handoff_custody_negative():
+    # release_owner in a finally (the _dispatch_all shape), acquire as
+    # the returned expression (the begin_handoff shape), transfer-as-
+    # releaser, channel committed into the router table at birth,
+    # with-managed channel, socket closed in a finally
+    assert hits("l1_handoff_neg.py", "L1") == []
+
+
+def test_l1_handoff_seeded_fault_names_the_staging_line():
+    """The disagg acceptance pin: a raise injected between
+    stage_handoff and the dispatch-side release_owner — L1 names the
+    staging line and the fault line."""
+    assert all_hits("l1_handoff_fault.py") == [("L1", 15)]
+    f = finding("l1_handoff_fault.py", "L1", 15)
+    assert "exception edge" in f.message
+    assert "escape at line 17" in f.message  # the injected raise
+
+
 def test_l2_terminal_coverage_positive():
     # orphaned admit (6: exception escape with no terminal), double
     # terminal (15: complete at 14 then failed, unguarded)

@@ -92,7 +92,7 @@ def test_batcher_end_to_end_chain():
     assert report == {"checked": 4, "complete": 4, "incomplete": {},
                       "requeued": 0, "repacked": 0, "hedged": 0,
                       "shadowed": 0, "degraded": 0, "rolled_back": 0,
-                      "streamed": 0, "re_prefilled": 0,
+                      "streamed": 0, "re_prefilled": 0, "handed_off": 0,
                       "speculated": 0, "accept_rate": None}
     chain = hop_chain(eng.tracer.records(), futs[0].rid)
     hops = [(r["attrs"]["hop"]) for r in chain]
